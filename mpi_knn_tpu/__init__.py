@@ -1,0 +1,34 @@
+"""mpi_knn_tpu — a TPU-native exact k-nearest-neighbor framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of ``yiapou13/mpi-knn``
+(brute-force all-pairs kNN search + leave-one-out kNN classification, serial
+and ring-distributed). Nothing here is a port: the reference's OpenMP distance
+loops (``/root/reference/knn-serial.c:72-93``) become MXU matmuls, its
+hand-rolled MPI ring (``/root/reference/mpi-knn-parallel_blocking.c:122-214``)
+becomes a ``lax.ppermute`` ring inside ``shard_map``, and its qsort-per-insert
+top-k (``/root/reference/knn-serial.c:86-91``) becomes on-device ``lax.top_k``
+merges.
+
+Public API::
+
+    from mpi_knn_tpu import all_knn, knn_classify, KNNConfig
+    result = all_knn(corpus, k=30)                # leave-one-out all-kNN
+    result = all_knn(corpus, queries=Q, k=10)     # query mode
+    pred   = knn_classify(result, labels, num_classes=10)
+"""
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.types import KNNResult
+from mpi_knn_tpu.api import all_knn, knn_classify
+from mpi_knn_tpu.models.classifier import KNNClassifier
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "KNNConfig",
+    "KNNResult",
+    "all_knn",
+    "knn_classify",
+    "KNNClassifier",
+    "__version__",
+]

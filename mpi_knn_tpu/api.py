@@ -1,0 +1,95 @@
+"""Public functional API.
+
+One entry point replaces the reference's three copy-pasted ``main()``s
+(SURVEY.md §1): the backend is a config field, not a separate program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.ops.vote import classify_from_labels
+from mpi_knn_tpu.types import ClassifyResult, KNNResult
+
+
+def resolve_backend(cfg: KNNConfig, mesh=None) -> str:
+    if cfg.backend != "auto":
+        return cfg.backend
+    n = cfg.num_devices or (len(mesh.devices.flat) if mesh is not None else len(jax.devices()))
+    return "ring-overlap" if n > 1 else "serial"
+
+
+def all_knn(
+    corpus,
+    queries=None,
+    config: Optional[KNNConfig] = None,
+    mesh=None,
+    **overrides,
+) -> KNNResult:
+    """All-kNN search.
+
+    Args:
+      corpus: (m, d) point matrix.
+      queries: (q, d) query matrix, or None for all-pairs leave-one-out mode —
+        the reference's workload: every corpus point queries the whole corpus
+        with itself excluded (``/root/reference/knn-serial.c:72-93``).
+      config: KNNConfig; individual fields may be overridden by kwargs, e.g.
+        ``all_knn(X, k=10, backend="ring")``.
+      mesh: optional jax.sharding.Mesh for the ring backends.
+
+    Returns:
+      KNNResult with (q, k) distances (sortable space, ascending) and 0-based
+      global ids.
+    """
+    cfg = (config or KNNConfig()).replace(**overrides)
+    corpus = np.asarray(corpus)
+    m = corpus.shape[0]
+
+    if queries is None:
+        q_arr = corpus
+        q_ids = np.arange(m, dtype=np.int32)
+    else:
+        q_arr = np.asarray(queries)
+        # no query has a corpus identity in query mode; -1 never matches a
+        # *valid* candidate id, so self-exclusion is a no-op
+        q_ids = np.full(q_arr.shape[0], -1, dtype=np.int32)
+
+    if cfg.center and cfg.metric == "l2":
+        # translation leaves L2 distances unchanged but conditions the
+        # ‖x‖²+‖y‖²−2xy form: cancellation error tracks the centered norms
+        mu = corpus.astype(np.float64).mean(axis=0)
+        corpus = corpus - mu
+        q_arr = q_arr - mu if queries is not None else corpus
+
+    backend = resolve_backend(cfg, mesh)
+    if backend == "serial":
+        from mpi_knn_tpu.backends.serial import all_knn_serial
+
+        d, i = all_knn_serial(corpus, q_arr, q_ids, cfg)
+    elif backend in ("ring", "ring-overlap"):
+        from mpi_knn_tpu.backends.ring import all_knn_ring
+
+        d, i = all_knn_ring(
+            corpus, q_arr, q_ids, cfg, mesh=mesh, overlap=(backend == "ring-overlap")
+        )
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    return KNNResult(dists=d, ids=i)
+
+
+def knn_classify(
+    result: KNNResult,
+    labels,
+    num_classes: int = 10,
+    tie_break: str = "nearest",
+) -> ClassifyResult:
+    """Majority-vote classification over a KNNResult (reference C10)."""
+    import jax.numpy as jnp
+
+    return classify_from_labels(
+        result.ids, jnp.asarray(labels), num_classes, tie_break=tie_break
+    )

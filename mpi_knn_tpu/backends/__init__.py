@@ -1,0 +1,3 @@
+from mpi_knn_tpu.backends.serial import all_knn_serial
+
+__all__ = ["all_knn_serial"]

@@ -1,0 +1,158 @@
+"""Serial (single-device) backend — the ground-truth execution path,
+replacing the reference's serial driver (SURVEY.md C5,
+``/root/reference/knn-serial.c:36-133``).
+
+Same math as the distributed backends, unsharded: the (q × c) distance
+problem is tiled into MXU-sized blocks; a ``lax.scan`` streams corpus tiles
+through VMEM while a per-query top-k carry is merged tile by tile, and a
+``lax.map`` walks query tiles so peak memory is
+O(query_tile × corpus_tile + q × k) instead of the reference's full
+m × NN neighbour matrix on the *stack* (~28.8 MB of VLAs,
+``/root/reference/knn-serial.c:54-55``).
+
+Everything below ``_all_knn_padded`` is traced once per (shape, config) and
+compiled by XLA; there is no per-candidate host control flow.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.ops.distance import pairwise_dist, sq_norms
+from mpi_knn_tpu.ops.topk import init_topk, mask_tile, smallest_k
+from mpi_knn_tpu.parallel.partition import (
+    make_global_ids,
+    pad_rows,
+    pad_to_multiple,
+)
+
+
+def knn_tile_step(
+    q_x: jax.Array,
+    q_ids: jax.Array,
+    q_sq: jax.Array | None,
+    blk: jax.Array,
+    blk_ids: jax.Array,
+    blk_sq: jax.Array | None,
+    carry_d: jax.Array,
+    carry_i: jax.Array,
+    cfg: KNNConfig,
+):
+    """One fused (query_tile × corpus_tile) step: distances → masks → merged
+    top-k. Shared by the serial backend and the ring backends (the ring runs
+    exactly this against each rotating corpus block)."""
+    d = pairwise_dist(
+        q_x,
+        blk,
+        metric=cfg.metric,
+        x_sq=q_sq,
+        y_sq=blk_sq,
+        precision=cfg.matmul_precision,
+    )
+    if cfg.metric == "l2" and q_sq is not None and blk_sq is not None:
+        pair_scale = q_sq[:, None] + blk_sq[None, :]
+    else:
+        # cosine distances live in [0, 2]; constant scale for the zero test
+        pair_scale = jnp.asarray(2.0, dtype=d.dtype)
+    d = mask_tile(
+        d,
+        blk_ids,
+        query_ids=q_ids if cfg.exclude_self else None,
+        exclude_self=cfg.exclude_self,
+        exclude_zero=cfg.exclude_zero,
+        zero_eps=cfg.zero_eps,
+        scale=pair_scale,
+    )
+    all_d = jnp.concatenate([carry_d, d.astype(carry_d.dtype)], axis=-1)
+    all_i = jnp.concatenate(
+        [carry_i, jnp.broadcast_to(blk_ids[None, :], d.shape)], axis=-1
+    )
+    return smallest_k(
+        all_d,
+        all_i,
+        cfg.k,
+        method=cfg.topk_method,
+        recall_target=cfg.recall_target,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _all_knn_padded(
+    queries: jax.Array,  # (Q, d) padded to query_tile multiple
+    query_ids: jax.Array,  # (Q,)
+    corpus_tiles: jax.Array,  # (T, corpus_tile, d)
+    corpus_tile_ids: jax.Array,  # (T, corpus_tile)
+    cfg: KNNConfig,
+):
+    acc = jnp.float64 if queries.dtype == jnp.float64 else jnp.float32
+    if cfg.metric == "l2":
+        corpus_sq = jax.vmap(sq_norms)(corpus_tiles)  # (T, corpus_tile)
+    else:
+        corpus_sq = jnp.zeros(corpus_tiles.shape[:2], dtype=acc)
+
+    num_q = queries.shape[0]
+    qt = cfg.query_tile
+    q_tiles = queries.reshape(num_q // qt, qt, queries.shape[1])
+    q_id_tiles = query_ids.reshape(num_q // qt, qt)
+
+    def per_query_tile(args):
+        q_x, q_ids = args
+        q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
+
+        def scan_step(carry, tile):
+            blk, blk_ids, blk_sq = tile
+            return (
+                knn_tile_step(
+                    q_x, q_ids, q_sq, blk, blk_ids, blk_sq, *carry, cfg
+                ),
+                None,
+            )
+
+        carry = init_topk(qt, cfg.k, dtype=acc)
+        (best_d, best_i), _ = jax.lax.scan(
+            scan_step, carry, (corpus_tiles, corpus_tile_ids, corpus_sq)
+        )
+        return best_d, best_i
+
+    return jax.lax.map(per_query_tile, (q_tiles, q_id_tiles))
+
+
+def all_knn_serial(
+    corpus: np.ndarray,
+    queries: np.ndarray,
+    query_ids: np.ndarray,
+    cfg: KNNConfig,
+):
+    """Host-side wrapper: pad to tile multiples, run the jitted core, strip
+    padding. Returns ((q, k) dists, (q, k) ids) device arrays."""
+    m, dim = corpus.shape
+    nq = queries.shape[0]
+
+    c_pad = pad_to_multiple(m, cfg.corpus_tile)
+    q_pad = pad_to_multiple(nq, cfg.query_tile)
+
+    corpus_p = pad_rows(np.asarray(corpus), c_pad)
+    corpus_ids = make_global_ids(m, c_pad)
+    tiles = c_pad // cfg.corpus_tile
+    corpus_tiles = corpus_p.reshape(tiles, cfg.corpus_tile, dim)
+    corpus_tile_ids = corpus_ids.reshape(tiles, cfg.corpus_tile)
+
+    queries_p = pad_rows(np.asarray(queries), q_pad)
+    qids_p = pad_rows(np.asarray(query_ids, dtype=np.int32), q_pad, fill=-1)
+
+    dtype = jnp.dtype(cfg.dtype)
+    best_d, best_i = _all_knn_padded(
+        jnp.asarray(queries_p, dtype=dtype),
+        jnp.asarray(qids_p),
+        jnp.asarray(corpus_tiles, dtype=dtype),
+        jnp.asarray(corpus_tile_ids),
+        cfg,
+    )
+    best_d = best_d.reshape(q_pad, cfg.k)[:nq]
+    best_i = best_i.reshape(q_pad, cfg.k)[:nq]
+    return best_d, best_i

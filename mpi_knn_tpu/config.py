@@ -1,0 +1,99 @@
+"""Configuration for the framework.
+
+Everything the reference hardcodes becomes a field here with the reference's
+value as the default: ``k=30`` (``#define NN 30``, ``/root/reference/knn-serial.c:8``),
+``num_classes=10`` (``#define max 10``, ``knn-serial.c:9``), zero-distance
+self-exclusion (``knn-serial.c:86``). Changing k in the reference required
+recompiling (SURVEY.md C12); here it is a dataclass field / CLI flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+BACKENDS = ("auto", "serial", "ring", "ring-overlap")
+METRICS = ("l2", "cosine")
+TOPK_METHODS = ("exact", "approx")
+TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
+
+
+@dataclasses.dataclass(frozen=True)
+class KNNConfig:
+    """All knobs for an all-kNN run.
+
+    Attributes:
+      k: neighbors per query (reference: compile-time ``NN=30``).
+      metric: ``l2`` (compared in squared space — same order, SURVEY.md Q10)
+        or ``cosine`` (1 − cosine similarity).
+      backend: ``serial`` (single device), ``ring`` (blocking-parity ppermute
+        ring), ``ring-overlap`` (pipelined ring with compute/comm overlap —
+        the capability the reference's non-blocking variant intended but never
+        achieved, SURVEY.md Q7), ``pallas`` (fused kernel path), or ``auto``.
+      query_tile / corpus_tile: on-device tiling of the (q × c) distance
+        computation. Tiles are MXU-aligned (multiples of 128 recommended).
+      dtype: input compute dtype. float32 default; bfloat16 for peak MXU
+        throughput; float64 as the tie-adjudication debug mode (SURVEY.md Q10).
+      exclude_self: mask a candidate whose global id equals the query's own id
+        (exact replacement for leave-one-out; robust under fp, unlike the
+        reference's value test).
+      exclude_zero: additionally mask candidates at (numerically) zero
+        distance — the reference's semantics, which also drops exact duplicate
+        points (``sqrt(S) != 0``, ``/root/reference/knn-serial.c:86``).
+      zero_eps: threshold for ``exclude_zero`` in squared-distance space.
+      topk_method: ``exact`` (``lax.top_k``) or ``approx``
+        (``lax.approx_min_k``, the TPU-optimized partial reduction from the
+        TPU-KNN paper — see PAPERS.md).
+      recall_target: recall target for ``approx`` top-k.
+      tie_break: vote tie-break. ``nearest`` = correct majority vote with
+        nearest-neighbor tie-break; ``lowest`` = lowest class id wins ties;
+        ``quirk-serial`` / ``quirk-mpi`` bit-replicate the reference's buggy
+        vote loops for parity experiments (SURVEY.md Q4).
+      mesh_axis: name of the ring mesh axis for distributed backends.
+      num_devices: ring size; None = all visible devices.
+    """
+
+    k: int = 30
+    metric: str = "l2"
+    backend: str = "auto"
+    query_tile: int = 1024
+    corpus_tile: int = 2048
+    dtype: str = "float32"
+    # None = auto: HIGHEST for f32/f64 inputs (recall-parity anchor; TPU's
+    # DEFAULT truncates f32 operands to bf16 — measured ~0.3% recall@10 loss),
+    # DEFAULT for bf16 inputs. Explicit "default"/"high"/"highest" overrides.
+    matmul_precision: Optional[str] = None
+    # mean-center data before L2 distance computation (host-side, one pass).
+    # L2 distances are translation-invariant, so results are mathematically
+    # unchanged — but cancellation error in the matmul form scales with the
+    # *centered* norms, which keeps fp noise (and the relative zero-distance
+    # threshold) tight even when the data sits far from the origin.
+    center: bool = True
+    exclude_self: bool = True
+    exclude_zero: bool = True
+    zero_eps: float = 0.0
+    topk_method: str = "exact"
+    recall_target: float = 0.95
+    tie_break: str = "nearest"
+    num_classes: int = 10
+    mesh_axis: str = "ring"
+    num_devices: Optional[int] = None
+
+    def __post_init__(self):
+        if self.backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {self.backend!r}")
+        if self.metric not in METRICS:
+            raise ValueError(f"metric must be one of {METRICS}, got {self.metric!r}")
+        if self.topk_method not in TOPK_METHODS:
+            raise ValueError(
+                f"topk_method must be one of {TOPK_METHODS}, got {self.topk_method!r}"
+            )
+        if self.tie_break not in TIE_BREAKS:
+            raise ValueError(
+                f"tie_break must be one of {TIE_BREAKS}, got {self.tie_break!r}"
+            )
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+
+    def replace(self, **kw) -> "KNNConfig":
+        return dataclasses.replace(self, **kw)

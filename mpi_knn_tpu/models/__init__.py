@@ -1,0 +1,3 @@
+from mpi_knn_tpu.models.classifier import KNNClassifier
+
+__all__ = ["KNNClassifier"]

@@ -1,0 +1,14 @@
+from mpi_knn_tpu.ops.distance import pairwise_sq_l2, pairwise_cosine, pairwise_dist
+from mpi_knn_tpu.ops.topk import smallest_k, merge_topk, init_topk
+from mpi_knn_tpu.ops.vote import vote, classify_from_labels
+
+__all__ = [
+    "pairwise_sq_l2",
+    "pairwise_cosine",
+    "pairwise_dist",
+    "smallest_k",
+    "merge_topk",
+    "init_topk",
+    "vote",
+    "classify_from_labels",
+]

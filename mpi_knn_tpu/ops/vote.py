@@ -1,0 +1,123 @@
+"""Majority-vote classification — the replacement for the reference's label
+voting (SURVEY.md C10).
+
+The reference histograms the 30 neighbor labels into ``class[10]`` and scans
+for the winner with a tie-break that conflates vote *counts* with class
+*labels* (``most`` starts as a count, becomes ``j+1``;
+``/root/reference/knn-serial.c:113-124``) — and the MPI variants' tie
+condition differs from the serial one by an off-by-one
+(``/root/reference/mpi-knn-parallel_blocking.c:263-266``), so the two programs
+disagree on ties (SURVEY.md §5 Q4).
+
+Here the vote is a one-hot sum + argmax on device, with a *correct*
+nearest-neighbor tie-break by default, plus two quirk-compat modes that
+bit-replicate each reference loop for parity experiments.
+
+Class labels are 0-based ints in [0, num_classes) throughout the framework;
+the data layer maps the reference's 1-based MNIST labels at the boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mpi_knn_tpu.types import ClassifyResult
+
+
+def vote_counts(
+    neigh_labels: jax.Array, valid: jax.Array, num_classes: int
+) -> jax.Array:
+    """(q, k) 0-based labels + (q, k) validity -> (q, C) int32 histogram."""
+    labels = jnp.where(valid, neigh_labels, 0)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.int32)
+    onehot = onehot * valid[..., None].astype(jnp.int32)
+    return jnp.sum(onehot, axis=-2)
+
+
+def _quirk_vote(counts: jax.Array, cmp_j: jax.Array) -> jax.Array:
+    """Bit-replication of the reference's winner scan.
+
+    The C loop is::
+
+        most = 0;
+        for (j = 0; j < C; j++)
+          if (class[j] > most || (class[j] == most && <tie-cond>)) most = j + 1;
+
+    After the first assignment ``most`` holds a *label*, so later iterations
+    compare a count against a label — faithfully reproduced here. ``cmp_j`` is
+    the j value that satisfies the tie condition: for the serial program the
+    condition ``(j+1) == raw_nearest_label`` means ``cmp_j = nearest_class``
+    (0-based); for the MPI programs ``(j+1) == raw_nearest_label − 1`` means
+    ``cmp_j = nearest_class − 1``.
+
+    Returns 0-based predictions; −1 if the loop never assigned (all counts 0
+    and no tie hit — cannot happen with k ≥ 1 valid neighbors).
+    """
+    num_classes = counts.shape[-1]
+
+    def body(most, j):
+        cj = counts[:, j]
+        take = (cj > most) | ((cj == most) & (j == cmp_j))
+        return jnp.where(take, j + 1, most), None
+
+    init = jnp.zeros(counts.shape[0], dtype=counts.dtype)
+    most, _ = jax.lax.scan(body, init, jnp.arange(num_classes))
+    return (most - 1).astype(jnp.int32)
+
+
+def vote(
+    neigh_labels: jax.Array,
+    valid: jax.Array,
+    num_classes: int,
+    tie_break: str = "nearest",
+) -> ClassifyResult:
+    """Classify each query by majority vote over its neighbors' labels.
+
+    Args:
+      neigh_labels: (q, k) 0-based class of each neighbor, ascending distance
+        order (column 0 = nearest) — the order KNNResult guarantees.
+      valid: (q, k) bool, False for padded/invalid slots.
+      num_classes: C.
+      tie_break: "nearest" | "lowest" | "quirk-serial" | "quirk-mpi".
+    """
+    counts = vote_counts(neigh_labels, valid, num_classes)
+    nearest = jnp.where(valid[:, 0], neigh_labels[:, 0], 0).astype(jnp.int32)
+
+    if tie_break == "quirk-serial":
+        pred = _quirk_vote(counts, nearest)
+    elif tie_break == "quirk-mpi":
+        pred = _quirk_vote(counts, nearest - 1)
+    else:
+        maxc = jnp.max(counts, axis=-1, keepdims=True)
+        tied = counts == maxc
+        lowest = jnp.argmax(tied, axis=-1).astype(jnp.int32)
+        if tie_break == "lowest":
+            pred = lowest
+        elif tie_break == "nearest":
+            nearest_is_tied = jnp.take_along_axis(
+                tied, nearest[:, None], axis=-1
+            )[:, 0]
+            pred = jnp.where(nearest_is_tied, nearest, lowest)
+        else:
+            raise ValueError(f"unknown tie_break {tie_break!r}")
+
+    return ClassifyResult(predictions=pred, counts=counts)
+
+
+def classify_from_labels(
+    ids: jax.Array,
+    labels: jax.Array,
+    num_classes: int,
+    tie_break: str = "nearest",
+) -> ClassifyResult:
+    """Gather neighbor labels from a global label vector and vote.
+
+    Args:
+      ids: (q, k) 0-based global neighbor ids from KNNResult (−1 = invalid).
+      labels: (m,) 0-based class per corpus point.
+    """
+    valid = ids >= 0
+    safe = jnp.where(valid, ids, 0)
+    neigh_labels = jnp.take(labels.astype(jnp.int32), safe, axis=0)
+    return vote(neigh_labels, valid, num_classes, tie_break=tie_break)

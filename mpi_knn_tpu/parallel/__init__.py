@@ -1,0 +1,4 @@
+from mpi_knn_tpu.parallel.partition import pad_rows, pad_to_multiple
+from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+
+__all__ = ["pad_rows", "pad_to_multiple", "make_ring_mesh"]

@@ -1,0 +1,37 @@
+"""Device mesh construction for the distributed backends.
+
+The reference's "mesh" is MPI_COMM_WORLD: a logical ring of P processes wired
+by hand from point-to-point sends (``/root/reference/mpi-knn-parallel_blocking.c:58-61,
+124-147``), with the partition size coming from argv and the ring size from
+MPI — two sources of truth that silently corrupt when they disagree
+(SURVEY.md §5 Q6). Here the mesh is the single source of truth: a 1-D
+``jax.sharding.Mesh`` whose axis order follows the physical device order, so
+``lax.ppermute`` steps ride neighboring ICI links. Multi-host runs build the
+same mesh over ``jax.devices()`` after ``jax.distributed.initialize`` (see
+mpi_knn_tpu.parallel.distributed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_ring_mesh(
+    num_devices: Optional[int] = None,
+    axis_name: str = "ring",
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh over the first `num_devices` visible devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))

@@ -1,0 +1,42 @@
+"""Corpus partitioning / padding — the replacement for the reference's block
+partitioner and augmented row matrix (SURVEY.md C6).
+
+The reference widens every corpus row to n+2 columns, smuggling the global id
+and label inside the float payload that circulates the MPI ring
+(``/root/reference/mpi-knn-parallel_blocking.c:100-109``), and silently
+requires the process count to divide m (SURVEY.md §5 Q6). Here ids/labels ride
+as separate int32 arrays sharded identically to the corpus, and divisibility
+is handled by padding with sentinel rows (id = −1) that the top-k masks force
+to +inf distance (SURVEY.md §8 "Divisibility/padding").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_knn_tpu.types import INVALID_ID
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest padded size >= n that is a multiple of `multiple` (>= 1)."""
+    if multiple <= 0:
+        raise ValueError(f"multiple must be positive, got {multiple}")
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pad_rows(x: np.ndarray, target_rows: int, fill=0.0) -> np.ndarray:
+    """Pad a (m, ...) array with `fill` rows up to target_rows (no-op if equal)."""
+    m = x.shape[0]
+    if target_rows < m:
+        raise ValueError(f"target_rows {target_rows} < rows {m}")
+    if target_rows == m:
+        return x
+    pad_width = [(0, target_rows - m)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width, constant_values=fill)
+
+
+def make_global_ids(m: int, padded: int) -> np.ndarray:
+    """0-based global ids for m real rows, INVALID_ID for padding rows."""
+    ids = np.full(padded, INVALID_ID, dtype=np.int32)
+    ids[:m] = np.arange(m, dtype=np.int32)
+    return ids

@@ -1,0 +1,65 @@
+"""Result containers.
+
+Replaces the reference's ``neighbour{distance, idx[, label]}`` array-of-structs
+(``/root/reference/knn-serial.c:14-18``) with structure-of-arrays device
+arrays: distances and global indices live in separate, MXU/VPU-friendly
+tensors; labels are gathered on demand from a label vector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel index used for padded / masked-out candidate rows.
+INVALID_ID = -1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class KNNResult:
+    """Top-k nearest neighbors for a batch of queries.
+
+    Attributes:
+      dists: (q, k) float array. Distances in *sortable* space — squared L2 for
+        the ``l2`` metric (monotone in true L2, per SURVEY.md §5 Q10), or
+        ``1 − cosine`` for the ``cosine`` metric. Ascending along k.
+      ids: (q, k) int32 array of 0-based global corpus ids (the reference uses
+        1-based ids, ``/root/reference/knn-serial.c:89``; use ``one_based()``
+        for parity output). ``INVALID_ID`` marks unfilled slots (k > valid
+        candidates).
+    """
+
+    dists: jax.Array
+    ids: jax.Array
+
+    @property
+    def k(self) -> int:
+        return self.ids.shape[-1]
+
+    def l2_dists(self) -> jax.Array:
+        """True (non-squared) L2 distances, like the reference compares in."""
+        return jnp.sqrt(jnp.maximum(self.dists, 0.0))
+
+    def one_based(self) -> jax.Array:
+        """1-based ids for bit-parity with the reference (invalid stays -1)."""
+        return jnp.where(self.ids >= 0, self.ids + 1, self.ids)
+
+    def valid(self) -> jax.Array:
+        return self.ids >= 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassifyResult:
+    """Output of kNN majority-vote classification (SURVEY.md C10)."""
+
+    predictions: jax.Array  # (q,) int32, 0-based class ids
+    counts: jax.Array  # (q, num_classes) int32 vote histogram
+
+    def matches(self, true_labels: Any) -> jax.Array:
+        """The reference's end-to-end oracle: number of correct predictions
+        (``/root/reference/knn-serial.c:127-130``)."""
+        return jnp.sum(self.predictions == jnp.asarray(true_labels))

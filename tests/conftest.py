@@ -1,0 +1,29 @@
+"""Test environment: force CPU with 8 virtual devices so the full ppermute
+ring runs without TPU hardware (SURVEY.md §4 "Distributed-without-a-cluster"),
+and enable x64 for the float64 debug/oracle paths (SURVEY.md §5 Q10).
+
+Must run before jax is imported anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores JAX_PLATFORMS; the config knob wins
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

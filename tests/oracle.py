@@ -1,0 +1,74 @@
+"""NumPy float64 oracle implementing the *reference's observable semantics*
+(SURVEY.md §4 "Parity"): full pairwise L2 distances, zero-distance exclusion
+by value (``/root/reference/knn-serial.c:86``), first-encountered-wins on
+exact ties (the reference tests ``sqrt(S) < worst`` strictly while scanning
+candidate index ascending), and the quirk vote loops. Deliberately naive —
+O(m·q·d) dense — so it can't share bugs with the device code."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def oracle_all_knn(
+    corpus: np.ndarray,
+    k: int,
+    queries: np.ndarray | None = None,
+    metric: str = "l2",
+    exclude_self: bool | None = None,
+    exclude_zero: bool = True,
+):
+    """Returns (dists (q,k) in sortable space [sq-l2 or 1-cos], ids (q,k))."""
+    corpus = np.asarray(corpus, dtype=np.float64)
+    all_pairs = queries is None
+    q = corpus if all_pairs else np.asarray(queries, dtype=np.float64)
+    if exclude_self is None:
+        exclude_self = all_pairs
+
+    if metric == "l2":
+        d = ((q[:, None, :] - corpus[None, :, :]) ** 2).sum(-1)
+    elif metric == "cosine":
+        qn = q / np.linalg.norm(q, axis=-1, keepdims=True)
+        cn = corpus / np.linalg.norm(corpus, axis=-1, keepdims=True)
+        d = 1.0 - qn @ cn.T
+        d = np.maximum(d, 0.0)
+    else:
+        raise ValueError(metric)
+
+    if exclude_zero:
+        d = np.where(d <= 0.0, np.inf, d)
+    if exclude_self and all_pairs:
+        np.fill_diagonal(d, np.inf)
+
+    order = np.argsort(d, axis=1, kind="stable")[:, :k]
+    dists = np.take_along_axis(d, order, axis=1)
+    ids = order.astype(np.int32)
+    ids[np.isinf(dists)] = -1
+    return dists, ids
+
+
+def oracle_vote_quirk(counts: np.ndarray, cmp_j: np.ndarray) -> np.ndarray:
+    """Literal python transcription of the reference winner scan semantics
+    (``knn-serial.c:121-124``): most conflates count and label."""
+    out = np.zeros(counts.shape[0], dtype=np.int64)
+    for r in range(counts.shape[0]):
+        most = 0
+        for j in range(counts.shape[1]):
+            if counts[r, j] > most or (counts[r, j] == most and j == cmp_j[r]):
+                most = j + 1
+        out[r] = most - 1
+    return out
+
+
+def oracle_vote_correct(
+    counts: np.ndarray, nearest: np.ndarray, tie_break: str = "nearest"
+) -> np.ndarray:
+    out = np.zeros(counts.shape[0], dtype=np.int64)
+    for r in range(counts.shape[0]):
+        maxc = counts[r].max()
+        tied = np.flatnonzero(counts[r] == maxc)
+        if tie_break == "nearest" and nearest[r] in tied:
+            out[r] = nearest[r]
+        else:
+            out[r] = tied[0]
+    return out

@@ -1,0 +1,78 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_knn_tpu.ops.distance import pairwise_cosine, pairwise_dist, pairwise_sq_l2
+
+
+def _np_sq_l2(x, y):
+    return ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+
+
+def test_sq_l2_matches_dense_oracle(rng):
+    x = rng.standard_normal((37, 19)).astype(np.float32)
+    y = rng.standard_normal((53, 19)).astype(np.float32)
+    got = np.asarray(pairwise_sq_l2(jnp.asarray(x), jnp.asarray(y)))
+    want = _np_sq_l2(x.astype(np.float64), y.astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sq_l2_f64_debug_mode_is_tight(rng):
+    x = rng.standard_normal((16, 33))
+    got = np.asarray(pairwise_sq_l2(jnp.asarray(x, dtype=jnp.float64), jnp.asarray(x, dtype=jnp.float64)))
+    want = _np_sq_l2(x, x)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-9)
+
+
+def test_sq_l2_self_distance_near_zero_and_clamped(rng):
+    x = rng.standard_normal((24, 64)).astype(np.float32) * 10
+    d = np.asarray(pairwise_sq_l2(jnp.asarray(x), jnp.asarray(x)))
+    assert (d >= 0).all()
+    # matmul-form cancellation keeps the diagonal near zero at f32
+    assert np.abs(np.diag(d)).max() < 1e-2 * np.abs(d).max()
+
+
+def test_sq_l2_bf16_inputs_accumulate_f32(rng):
+    x = rng.standard_normal((32, 128)).astype(np.float32)
+    got = np.asarray(
+        pairwise_sq_l2(jnp.asarray(x, dtype=jnp.bfloat16), jnp.asarray(x, dtype=jnp.bfloat16))
+    )
+    assert got.dtype == np.float32
+    want = _np_sq_l2(x.astype(np.float64), x.astype(np.float64))
+    # bf16 inputs: loose tolerance, but structure must hold
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=1.0)
+
+
+def test_precomputed_norms_are_equivalent(rng):
+    x = rng.standard_normal((8, 12)).astype(np.float32)
+    y = rng.standard_normal((9, 12)).astype(np.float32)
+    xs = (x.astype(np.float64) ** 2).sum(-1).astype(np.float32)
+    ys = (y.astype(np.float64) ** 2).sum(-1).astype(np.float32)
+    a = pairwise_sq_l2(jnp.asarray(x), jnp.asarray(y))
+    b = pairwise_sq_l2(jnp.asarray(x), jnp.asarray(y), x_sq=jnp.asarray(xs), y_sq=jnp.asarray(ys))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_cosine_distance(rng):
+    x = rng.standard_normal((21, 17)).astype(np.float32)
+    y = rng.standard_normal((13, 17)).astype(np.float32)
+    got = np.asarray(pairwise_cosine(jnp.asarray(x), jnp.asarray(y)))
+    xn = x / np.linalg.norm(x, axis=-1, keepdims=True)
+    yn = y / np.linalg.norm(y, axis=-1, keepdims=True)
+    want = np.maximum(1.0 - xn @ yn.T, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # self-similarity -> distance ~ 0
+    self_d = np.asarray(pairwise_cosine(jnp.asarray(x), jnp.asarray(x)))
+    assert np.abs(np.diag(self_d)).max() < 1e-5
+
+
+def test_metric_dispatch(rng):
+    x = jnp.asarray(rng.standard_normal((4, 5)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(pairwise_dist(x, x, "l2")), np.asarray(pairwise_sq_l2(x, x))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pairwise_dist(x, x, "cosine")), np.asarray(pairwise_cosine(x, x))
+    )
+    with pytest.raises(ValueError):
+        pairwise_dist(x, x, "manhattan")

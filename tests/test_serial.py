@@ -1,0 +1,162 @@
+"""Serial backend parity vs the reference-semantics oracle (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu import KNNClassifier, KNNConfig, all_knn, knn_classify
+from tests.oracle import oracle_all_knn
+
+
+def _blobs(rng, m=200, d=16, C=4, scale=6.0):
+    centers = rng.standard_normal((C, d)) * scale
+    y = rng.integers(0, C, size=m)
+    X = centers[y] + rng.standard_normal((m, d))
+    return X.astype(np.float32), y.astype(np.int32)
+
+
+def _assert_knn_matches(got, want_d, want_i, rtol=1e-3):
+    got_d = np.asarray(got.dists)
+    got_i = np.asarray(got.ids)
+    # distances match per-slot
+    np.testing.assert_allclose(got_d, want_d, rtol=rtol, atol=1e-3)
+    # id sets match per query (near-tie order may differ under f32)
+    for r in range(got_i.shape[0]):
+        assert set(got_i[r]) == set(want_i[r]), f"row {r}"
+
+
+def test_all_pairs_matches_oracle(rng):
+    X, _ = _blobs(rng, m=150, d=12)
+    cfg = KNNConfig(k=10, query_tile=64, corpus_tile=32)
+    got = all_knn(X, config=cfg, backend="serial")
+    want_d, want_i = oracle_all_knn(X, k=10)
+    _assert_knn_matches(got, want_d, want_i)
+
+
+def test_query_mode_matches_oracle(rng):
+    X, _ = _blobs(rng, m=120, d=8)
+    Q = rng.standard_normal((33, 8)).astype(np.float32)
+    got = all_knn(X, queries=Q, k=5, backend="serial", query_tile=16, corpus_tile=64)
+    want_d, want_i = oracle_all_knn(X, k=5, queries=Q)
+    _assert_knn_matches(got, want_d, want_i)
+
+
+def test_unpadded_shapes_dont_require_divisibility(rng):
+    """m and q deliberately not multiples of the tiles (reference required
+    P | m, SURVEY.md Q6 — we must not)."""
+    X, _ = _blobs(rng, m=101, d=7)
+    got = all_knn(X, k=7, backend="serial", query_tile=32, corpus_tile=48)
+    want_d, want_i = oracle_all_knn(X, k=7)
+    assert got.dists.shape == (101, 7)
+    _assert_knn_matches(got, want_d, want_i)
+
+
+def test_duplicate_points_excluded_by_value(rng):
+    """The reference's sqrt(S) != 0 rule drops exact duplicates too
+    (SURVEY.md Q3)."""
+    X, _ = _blobs(rng, m=40, d=5)
+    X[7] = X[3]  # exact duplicate pair
+    got = all_knn(X, k=6, backend="serial", query_tile=8, corpus_tile=16)
+    ids = np.asarray(got.ids)
+    assert 7 not in ids[3] and 3 not in ids[7]
+    # with value-exclusion off but self-exclusion on, the duplicate is a
+    # legitimate zero-distance neighbor
+    got2 = all_knn(
+        X, k=6, backend="serial", query_tile=8, corpus_tile=16, exclude_zero=False
+    )
+    ids2 = np.asarray(got2.ids)
+    assert ids2[3][0] == 7 and ids2[7][0] == 3
+
+
+def test_duplicate_exclusion_at_mnist_scale(rng):
+    """Regression: at MNIST-like magnitudes (pixel values 0..255, d=784) the
+    matmul-form distance of an exact duplicate pair is a small positive fp
+    residue, not 0 — the zero test must be scale-relative to fire."""
+    X = (rng.random((64, 784)) * 255.0).astype(np.float32)
+    X[11] = X[42]
+    got = all_knn(X, k=4, backend="serial", query_tile=32, corpus_tile=32)
+    ids = np.asarray(got.ids)
+    assert 42 not in ids[11] and 11 not in ids[42]
+
+
+def test_off_center_cluster_keeps_neighbors(rng):
+    """Regression: a tight cluster far from the origin (norm ~1000) must not
+    have its genuine neighbors swallowed by the zero-distance threshold —
+    mean-centering keeps the relative test honest."""
+    offset = np.full(32, 1000.0 / np.sqrt(32), dtype=np.float64)
+    X = (offset + rng.standard_normal((20, 32))).astype(np.float32)
+    got = all_knn(X, k=5, backend="serial", query_tile=8, corpus_tile=8)
+    ids = np.asarray(got.ids)
+    assert (ids >= 0).all(), "all neighbors must survive the zero test"
+    want_d, want_i = oracle_all_knn(X, k=5)
+    np.testing.assert_allclose(
+        np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_cosine_metric(rng):
+    X, _ = _blobs(rng, m=90, d=10)
+    got = all_knn(X, k=5, backend="serial", metric="cosine", query_tile=32, corpus_tile=32)
+    want_d, want_i = oracle_all_knn(X, k=5, metric="cosine")
+    np.testing.assert_allclose(np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-4)
+
+
+def test_k_larger_than_corpus(rng):
+    X, _ = _blobs(rng, m=6, d=4)
+    got = all_knn(X, k=10, backend="serial", query_tile=8, corpus_tile=8)
+    ids = np.asarray(got.ids)
+    # each query has only 5 valid neighbors (self excluded)
+    assert ((ids >= 0).sum(axis=1) == 5).all()
+    assert np.isinf(np.asarray(got.dists)[:, 5:]).all()
+
+
+def test_one_based_ids_parity_view(rng):
+    X, _ = _blobs(rng, m=30, d=4)
+    got = all_knn(X, k=3, backend="serial", query_tile=8, corpus_tile=8)
+    one = np.asarray(got.one_based())
+    zero = np.asarray(got.ids)
+    assert ((one == zero + 1) | (zero < 0)).all()
+
+
+def test_f64_debug_mode_exact_parity(rng):
+    X, _ = _blobs(rng, m=80, d=9)
+    got = all_knn(
+        X.astype(np.float64),
+        k=8,
+        backend="serial",
+        dtype="float64",
+        query_tile=16,
+        corpus_tile=32,
+    )
+    want_d, want_i = oracle_all_knn(X, k=8)
+    np.testing.assert_allclose(np.asarray(got.dists), want_d, rtol=1e-9, atol=1e-9)
+    np.testing.assert_array_equal(np.asarray(got.ids), want_i)
+
+
+def test_classifier_loo_end_to_end(rng):
+    X, y = _blobs(rng, m=160, d=10, C=4)
+    clf = KNNClassifier(k=5, num_classes=4, backend="serial", query_tile=32, corpus_tile=64)
+    report = clf.fit(X, y).loo_report()
+    assert report.total == 160
+    assert report.matches == int(
+        (np.asarray(report.classify.predictions) == y).sum()
+    )
+    # well-separated blobs: near-perfect leave-one-out accuracy
+    assert report.accuracy > 0.95
+
+
+def test_classifier_one_based_labels(rng):
+    X, y = _blobs(rng, m=60, d=6, C=3)
+    clf = KNNClassifier(
+        k=3, num_classes=3, backend="serial", one_based_labels=True,
+        query_tile=16, corpus_tile=32,
+    )
+    clf.fit(X, y + 1)
+    pred = clf.predict(X[:10])
+    assert pred.min() >= 1 and pred.max() <= 3
+
+
+def test_classifier_label_validation(rng):
+    X, y = _blobs(rng, m=20, d=4, C=3)
+    clf = KNNClassifier(k=3, num_classes=2)
+    with pytest.raises(ValueError):
+        clf.fit(X, y)  # labels reach 2 >= num_classes

@@ -1,0 +1,99 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_knn_tpu.ops.topk import init_topk, mask_tile, merge_topk, smallest_k
+from mpi_knn_tpu.types import INVALID_ID
+
+
+def _np_smallest_k(d, ids, k):
+    order = np.argsort(d, axis=-1, kind="stable")[:, :k]
+    return np.take_along_axis(d, order, -1), np.take_along_axis(ids, order, -1)
+
+
+def test_smallest_k_matches_argsort(rng):
+    d = rng.standard_normal((11, 40)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(40, dtype=np.int32), (11, 40))
+    got_d, got_i = smallest_k(jnp.asarray(d), jnp.asarray(ids[0]), 7)
+    want_d, want_i = _np_smallest_k(d, ids, 7)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
+def test_smallest_k_pads_when_k_exceeds_candidates(rng):
+    d = rng.standard_normal((3, 5)).astype(np.float32)
+    got_d, got_i = smallest_k(jnp.asarray(d), jnp.arange(5, dtype=jnp.int32), 9)
+    assert got_d.shape == (3, 9)
+    assert np.isinf(np.asarray(got_d)[:, 5:]).all()
+    assert (np.asarray(got_i)[:, 5:] == INVALID_ID).all()
+
+
+def test_inf_slots_get_invalid_ids():
+    d = jnp.asarray([[0.5, jnp.inf, 0.1]])
+    ids = jnp.asarray([7, 8, 9], dtype=jnp.int32)
+    got_d, got_i = smallest_k(d, ids, 3)
+    np.testing.assert_array_equal(np.asarray(got_i), [[9, 7, INVALID_ID]])
+
+
+def test_merge_associativity(rng):
+    """merge(merge(a,b),c) == smallest_k(a ‖ b ‖ c) — the property that makes
+    ring-order irrelevant (SURVEY.md §4 'Unit')."""
+    k = 6
+    q = 9
+    parts = []
+    for s in range(3):
+        d = rng.standard_normal((q, 15)).astype(np.float32)
+        ids = (np.arange(15, dtype=np.int32) + 100 * s)
+        parts.append((d, np.broadcast_to(ids, (q, 15))))
+
+    cd, ci = init_topk(q, k)
+    for d, ids in parts:
+        nd, ni = smallest_k(jnp.asarray(d), jnp.asarray(ids), k)
+        cd, ci = merge_topk(cd, ci, nd, ni)
+
+    all_d = np.concatenate([p[0] for p in parts], axis=-1)
+    all_i = np.concatenate([p[1] for p in parts], axis=-1)
+    want_d, want_i = _np_smallest_k(all_d, all_i, k)
+    np.testing.assert_allclose(np.asarray(cd), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(ci), want_i)
+
+
+def test_merge_commutativity(rng):
+    k = 4
+    da = rng.standard_normal((5, k)).astype(np.float32)
+    db = rng.standard_normal((5, k)).astype(np.float32)
+    ia = np.arange(k, dtype=np.int32) + np.zeros((5, 1), np.int32)
+    ib = ia + 50
+    ab = merge_topk(jnp.asarray(da), jnp.asarray(ia), jnp.asarray(db), jnp.asarray(ib))
+    ba = merge_topk(jnp.asarray(db), jnp.asarray(ib), jnp.asarray(da), jnp.asarray(ia))
+    np.testing.assert_array_equal(np.asarray(ab[0]), np.asarray(ba[0]))
+
+
+def test_mask_tile_padding_and_self_exclusion():
+    d = jnp.asarray([[1.0, 0.0, 2.0, 3.0]])
+    cand = jnp.asarray([0, 1, 2, INVALID_ID], dtype=jnp.int32)
+    qids = jnp.asarray([2], dtype=jnp.int32)
+    out = np.asarray(
+        mask_tile(d, cand, query_ids=qids, exclude_self=True, exclude_zero=True)
+    )
+    # candidate 1: zero distance -> excluded; candidate 2 == self; candidate 3 pad
+    np.testing.assert_array_equal(np.isinf(out), [[False, True, True, True]])
+
+
+def test_mask_tile_zero_eps():
+    d = jnp.asarray([[1e-13, 1e-3]])
+    cand = jnp.asarray([0, 1], dtype=jnp.int32)
+    out = np.asarray(mask_tile(d, cand, exclude_self=False, exclude_zero=True, zero_eps=1e-12))
+    assert np.isinf(out[0, 0]) and not np.isinf(out[0, 1])
+
+
+def test_approx_method_runs_on_cpu(rng):
+    d = rng.standard_normal((4, 64)).astype(np.float32)
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.arange(64, dtype=jnp.int32), 5, method="approx"
+    )
+    # on CPU approx_min_k falls back to exact
+    want_d, _ = _np_smallest_k(
+        d, np.broadcast_to(np.arange(64, dtype=np.int32), d.shape), 5
+    )
+    np.testing.assert_allclose(np.sort(np.asarray(got_d)), want_d, rtol=1e-6)

@@ -1,0 +1,94 @@
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_knn_tpu.ops.vote import classify_from_labels, vote, vote_counts
+from tests.oracle import oracle_vote_correct, oracle_vote_quirk
+
+
+def _random_votes(rng, q=50, k=30, C=10):
+    labels = rng.integers(0, C, size=(q, k)).astype(np.int32)
+    valid = np.ones((q, k), dtype=bool)
+    return labels, valid
+
+
+def test_vote_counts_histogram(rng):
+    labels, valid = _random_votes(rng)
+    counts = np.asarray(vote_counts(jnp.asarray(labels), jnp.asarray(valid), 10))
+    for r in range(labels.shape[0]):
+        want = np.bincount(labels[r], minlength=10)
+        np.testing.assert_array_equal(counts[r], want)
+
+
+def test_vote_counts_ignores_invalid():
+    labels = jnp.asarray([[1, 2, 2]], dtype=jnp.int32)
+    valid = jnp.asarray([[True, False, True]])
+    counts = np.asarray(vote_counts(labels, valid, 4))
+    np.testing.assert_array_equal(counts, [[0, 1, 1, 0]])
+
+
+def test_majority_wins_no_tie():
+    labels = jnp.asarray([[3, 3, 3, 1, 2]], dtype=jnp.int32)
+    valid = jnp.ones((1, 5), dtype=bool)
+    r = vote(labels, valid, 10, tie_break="nearest")
+    assert int(r.predictions[0]) == 3
+
+
+def test_nearest_tie_break():
+    # classes 2 and 5 tie at 2 votes; nearest neighbor (col 0) has class 5
+    labels = jnp.asarray([[5, 2, 2, 5, 7]], dtype=jnp.int32)
+    valid = jnp.ones((1, 5), dtype=bool)
+    assert int(vote(labels, valid, 10, tie_break="nearest").predictions[0]) == 5
+    # lowest mode picks class 2
+    assert int(vote(labels, valid, 10, tie_break="lowest").predictions[0]) == 2
+
+
+def test_nearest_not_in_tie_falls_back_to_lowest():
+    # classes 2 and 5 tie; nearest has class 7 (1 vote, not tied)
+    labels = jnp.asarray([[7, 2, 2, 5, 5]], dtype=jnp.int32)
+    valid = jnp.ones((1, 5), dtype=bool)
+    assert int(vote(labels, valid, 10, tie_break="nearest").predictions[0]) == 2
+
+
+def test_quirk_serial_matches_c_loop(rng):
+    labels, valid = _random_votes(rng, q=200, k=30, C=10)
+    r = vote(jnp.asarray(labels), jnp.asarray(valid), 10, tie_break="quirk-serial")
+    counts = np.asarray(r.counts)
+    # serial tie condition (j+1) == raw_nearest_label  =>  j == nearest class
+    want = oracle_vote_quirk(counts, labels[:, 0].astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(r.predictions), want)
+
+
+def test_quirk_mpi_matches_c_loop(rng):
+    labels, valid = _random_votes(rng, q=200, k=30, C=10)
+    r = vote(jnp.asarray(labels), jnp.asarray(valid), 10, tie_break="quirk-mpi")
+    counts = np.asarray(r.counts)
+    # mpi tie condition (j+1) == raw_nearest_label - 1  =>  j == nearest - 1
+    want = oracle_vote_quirk(counts, labels[:, 0].astype(np.int64) - 1)
+    np.testing.assert_array_equal(np.asarray(r.predictions), want)
+
+
+def test_quirk_modes_disagree_on_ties():
+    """Serial and MPI reference programs disagree on ties (SURVEY.md Q4) —
+    the quirk modes must reproduce that disagreement."""
+    # one vote each for classes 0 and 1; nearest is class 1
+    labels = jnp.asarray([[1, 0]], dtype=jnp.int32)
+    valid = jnp.ones((1, 2), dtype=bool)
+    s = int(vote(labels, valid, 3, tie_break="quirk-serial").predictions[0])
+    m = int(vote(labels, valid, 3, tie_break="quirk-mpi").predictions[0])
+    assert s != m
+
+
+def test_correct_vote_against_oracle(rng):
+    labels, valid = _random_votes(rng, q=300, k=7, C=5)
+    r = vote(jnp.asarray(labels), jnp.asarray(valid), 5, tie_break="nearest")
+    want = oracle_vote_correct(np.asarray(r.counts), labels[:, 0], "nearest")
+    np.testing.assert_array_equal(np.asarray(r.predictions), want)
+
+
+def test_classify_from_labels_gathers_and_masks():
+    ids = jnp.asarray([[2, 0, -1]], dtype=jnp.int32)
+    labels = jnp.asarray([4, 1, 4], dtype=jnp.int32)
+    r = classify_from_labels(ids, labels, 5)
+    np.testing.assert_array_equal(np.asarray(r.counts), [[0, 0, 0, 0, 2]])
+    assert int(r.predictions[0]) == 4
+    assert int(r.matches(jnp.asarray([4]))) == 1
