@@ -1,0 +1,3 @@
+from mpi_knn_tpu.cli import main
+
+raise SystemExit(main())

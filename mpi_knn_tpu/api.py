@@ -76,6 +76,10 @@ def all_knn(
         d, i = all_knn_ring(
             corpus, q_arr, q_ids, cfg, mesh=mesh, overlap=(backend == "ring-overlap")
         )
+    elif backend == "pallas":
+        from mpi_knn_tpu.backends.pallas_backend import all_knn_pallas
+
+        d, i = all_knn_pallas(corpus, q_arr, q_ids, cfg)
     else:
         raise ValueError(f"unknown backend {backend!r}")
     return KNNResult(dists=d, ids=i)

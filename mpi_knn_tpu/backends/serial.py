@@ -10,8 +10,10 @@ O(query_tile × corpus_tile + q × k) instead of the reference's full
 m × NN neighbour matrix on the *stack* (~28.8 MB of VLAs,
 ``/root/reference/knn-serial.c:54-55``).
 
-Everything below ``_all_knn_padded`` is traced once per (shape, config) and
-compiled by XLA; there is no per-candidate host control flow.
+``knn_chunk_update`` is the single jitted core: the plain serial path calls
+it once over all corpus tiles; the resumable driver (backends.resumable)
+calls it per checkpoint round with the carry threaded through; the ring
+backends run ``knn_tile_step`` against each rotating block.
 """
 
 from __future__ import annotations
@@ -44,8 +46,7 @@ def knn_tile_step(
     cfg: KNNConfig,
 ):
     """One fused (query_tile × corpus_tile) step: distances → masks → merged
-    top-k. Shared by the serial backend and the ring backends (the ring runs
-    exactly this against each rotating corpus block)."""
+    top-k. Shared by every backend."""
     d = pairwise_dist(
         q_x,
         blk,
@@ -82,44 +83,71 @@ def knn_tile_step(
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def _all_knn_padded(
-    queries: jax.Array,  # (Q, d) padded to query_tile multiple
-    query_ids: jax.Array,  # (Q,)
-    corpus_tiles: jax.Array,  # (T, corpus_tile, d)
-    corpus_tile_ids: jax.Array,  # (T, corpus_tile)
+def knn_chunk_update(
+    q_tiles: jax.Array,  # (QT, q_tile, d)
+    qid_tiles: jax.Array,  # (QT, q_tile)
+    chunk_tiles: jax.Array,  # (T, c_tile, d) corpus tiles to merge in
+    chunk_ids: jax.Array,  # (T, c_tile)
+    carry_d: jax.Array,  # (QT, q_tile, k)
+    carry_i: jax.Array,
     cfg: KNNConfig,
 ):
-    acc = jnp.float64 if queries.dtype == jnp.float64 else jnp.float32
+    """Merge a chunk of corpus tiles into the per-query top-k carry: scan
+    over corpus tiles inside a map over query tiles. The one compiled core
+    behind both the serial backend and the resumable driver."""
+    acc = jnp.float64 if q_tiles.dtype == jnp.float64 else jnp.float32
     if cfg.metric == "l2":
-        corpus_sq = jax.vmap(sq_norms)(corpus_tiles)  # (T, corpus_tile)
+        chunk_sq = jax.vmap(sq_norms)(chunk_tiles)
     else:
-        corpus_sq = jnp.zeros(corpus_tiles.shape[:2], dtype=acc)
-
-    num_q = queries.shape[0]
-    qt = cfg.query_tile
-    q_tiles = queries.reshape(num_q // qt, qt, queries.shape[1])
-    q_id_tiles = query_ids.reshape(num_q // qt, qt)
+        chunk_sq = jnp.zeros(chunk_tiles.shape[:2], dtype=acc)
 
     def per_query_tile(args):
-        q_x, q_ids = args
+        q_x, q_ids, cd, ci = args
         q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
 
-        def scan_step(carry, tile):
+        def step(carry, tile):
             blk, blk_ids, blk_sq = tile
             return (
-                knn_tile_step(
-                    q_x, q_ids, q_sq, blk, blk_ids, blk_sq, *carry, cfg
-                ),
+                knn_tile_step(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, *carry, cfg),
                 None,
             )
 
-        carry = init_topk(qt, cfg.k, dtype=acc)
-        (best_d, best_i), _ = jax.lax.scan(
-            scan_step, carry, (corpus_tiles, corpus_tile_ids, corpus_sq)
-        )
-        return best_d, best_i
+        out, _ = jax.lax.scan(step, (cd, ci), (chunk_tiles, chunk_ids, chunk_sq))
+        return out
 
-    return jax.lax.map(per_query_tile, (q_tiles, q_id_tiles))
+    return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, carry_d, carry_i))
+
+
+def effective_tiles(cfg: KNNConfig, m: int, nq: int) -> tuple[int, int]:
+    """Clamp configured tiles to the (aligned) problem size so small inputs
+    don't pay full-tile padding compute."""
+    q_tile = min(cfg.query_tile, pad_to_multiple(nq, 8))
+    c_tile = min(cfg.corpus_tile, pad_to_multiple(m, 128))
+    return q_tile, c_tile
+
+
+def prepare_tiles(corpus, queries, query_ids, cfg: KNNConfig, q_tile, c_tile):
+    """Pad + reshape host arrays into device tile stacks."""
+    m, dim = corpus.shape
+    nq = queries.shape[0]
+    dtype = jnp.dtype(cfg.dtype)
+
+    c_pad = pad_to_multiple(m, c_tile)
+    q_pad = pad_to_multiple(nq, q_tile)
+
+    corpus_tiles = jnp.asarray(
+        pad_rows(np.asarray(corpus), c_pad).reshape(-1, c_tile, dim), dtype=dtype
+    )
+    corpus_tile_ids = jnp.asarray(make_global_ids(m, c_pad).reshape(-1, c_tile))
+    q_tiles = jnp.asarray(
+        pad_rows(np.asarray(queries), q_pad).reshape(-1, q_tile, dim), dtype=dtype
+    )
+    qid_tiles = jnp.asarray(
+        pad_rows(np.asarray(query_ids, dtype=np.int32), q_pad, fill=-1).reshape(
+            -1, q_tile
+        )
+    )
+    return q_tiles, qid_tiles, corpus_tiles, corpus_tile_ids, q_pad
 
 
 def all_knn_serial(
@@ -130,29 +158,22 @@ def all_knn_serial(
 ):
     """Host-side wrapper: pad to tile multiples, run the jitted core, strip
     padding. Returns ((q, k) dists, (q, k) ids) device arrays."""
-    m, dim = corpus.shape
     nq = queries.shape[0]
-
-    c_pad = pad_to_multiple(m, cfg.corpus_tile)
-    q_pad = pad_to_multiple(nq, cfg.query_tile)
-
-    corpus_p = pad_rows(np.asarray(corpus), c_pad)
-    corpus_ids = make_global_ids(m, c_pad)
-    tiles = c_pad // cfg.corpus_tile
-    corpus_tiles = corpus_p.reshape(tiles, cfg.corpus_tile, dim)
-    corpus_tile_ids = corpus_ids.reshape(tiles, cfg.corpus_tile)
-
-    queries_p = pad_rows(np.asarray(queries), q_pad)
-    qids_p = pad_rows(np.asarray(query_ids, dtype=np.int32), q_pad, fill=-1)
-
-    dtype = jnp.dtype(cfg.dtype)
-    best_d, best_i = _all_knn_padded(
-        jnp.asarray(queries_p, dtype=dtype),
-        jnp.asarray(qids_p),
-        jnp.asarray(corpus_tiles, dtype=dtype),
-        jnp.asarray(corpus_tile_ids),
-        cfg,
+    q_tile, c_tile = effective_tiles(cfg, corpus.shape[0], nq)
+    q_tiles, qid_tiles, corpus_tiles, corpus_tile_ids, q_pad = prepare_tiles(
+        corpus, queries, query_ids, cfg, q_tile, c_tile
     )
-    best_d = best_d.reshape(q_pad, cfg.k)[:nq]
-    best_i = best_i.reshape(q_pad, cfg.k)[:nq]
-    return best_d, best_i
+
+    acc = jnp.float64 if q_tiles.dtype == jnp.float64 else jnp.float32
+    qt_count = q_pad // q_tile
+    carry_d, carry_i = init_topk(q_pad, cfg.k, dtype=acc)
+    carry_d = carry_d.reshape(qt_count, q_tile, cfg.k)
+    carry_i = carry_i.reshape(qt_count, q_tile, cfg.k)
+
+    best_d, best_i = knn_chunk_update(
+        q_tiles, qid_tiles, corpus_tiles, corpus_tile_ids, carry_d, carry_i, cfg
+    )
+    return (
+        best_d.reshape(q_pad, cfg.k)[:nq],
+        best_i.reshape(q_pad, cfg.k)[:nq],
+    )

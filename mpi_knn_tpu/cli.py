@@ -1,0 +1,254 @@
+"""Command-line interface (SURVEY.md C12).
+
+Everything the reference hardcodes — filename (``knn-serial.c:40``), k
+(``#define NN 30``), class count (``#define max 10``), metric, process/thread
+counts from bare argv (``mpi-knn-parallel_blocking.c:53-54``) — is a flag
+here, with the reference's values as defaults. One binary, backend selected
+by flag, replacing the reference's three separate programs.
+
+Examples::
+
+    python -m mpi_knn_tpu --data mnist --k 30 --loo
+    python -m mpi_knn_tpu --data synthetic:2048x64c10 --backend ring-overlap
+    python -m mpi_knn_tpu --data corpus.mat --svd 64 --k 10 --report out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+import numpy as np
+
+from mpi_knn_tpu.config import BACKENDS, METRICS, TIE_BREAKS, KNNConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_knn_tpu",
+        description="TPU-native brute-force kNN search + classification",
+    )
+    d = p.add_argument_group("data")
+    d.add_argument(
+        "--data",
+        default="mnist",
+        help="'mnist' (real if found, else synthetic), 'synthetic:MxDcC' "
+        "(e.g. synthetic:4096x128c10), or a .mat file with "
+        "train_X/train_labels in the reference layout",
+    )
+    d.add_argument("--limit", type=int, default=None, help="use first N rows only")
+    d.add_argument("--svd", type=int, default=None, metavar="DIM",
+                   help="reduce the corpus to DIM principal components first "
+                   "(the mnist_train_svd configuration)")
+
+    k = p.add_argument_group("kNN")
+    k.add_argument("--k", type=int, default=30, help="neighbors (reference NN=30)")
+    k.add_argument("--metric", choices=METRICS, default="l2")
+    k.add_argument("--backend", choices=BACKENDS, default="auto")
+    k.add_argument("--num-classes", type=int, default=10)
+    k.add_argument("--tie-break", choices=TIE_BREAKS, default="nearest")
+    k.add_argument("--devices", type=int, default=None,
+                   help="ring size for distributed backends (default: all)")
+    k.add_argument("--query-tile", type=int, default=1024)
+    k.add_argument("--corpus-tile", type=int, default=2048)
+    k.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16", "float64"])
+    k.add_argument("--topk-method", choices=["exact", "approx"], default="exact")
+    k.add_argument("--include-zero-dist", action="store_true",
+                   help="keep zero-distance (duplicate) neighbors — the "
+                   "reference excludes them (knn-serial.c:86)")
+    k.add_argument("--include-self", action="store_true",
+                   help="keep each point as its own neighbor in all-pairs mode")
+
+    o = p.add_argument_group("output")
+    o.add_argument("--loo", action="store_true",
+                   help="leave-one-out classification (the reference's "
+                   "workload); default when no --queries")
+    o.add_argument("--queries", default=None,
+                   help=".mat/.npy file of query points (query mode)")
+    o.add_argument("--report", default=None, help="write JSON report here")
+    o.add_argument("--one-based-ids", action="store_true",
+                   help="print 1-based neighbor ids (reference parity)")
+    o.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace for TensorBoard/XProf")
+    o.add_argument("--checkpoint-dir", default=None,
+                   help="round-granular checkpoint/resume state directory "
+                   "(serial backend)")
+    o.add_argument("--save-every", type=int, default=8,
+                   help="corpus tiles per checkpoint round")
+    o.add_argument("-q", "--quiet", action="store_true")
+    o.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
+                   help="force a JAX platform (some TPU plugins ignore the "
+                   "JAX_PLATFORMS env var; this uses the config knob)")
+    return p
+
+
+def _load_data(args):
+    """Returns (X, labels_or_None, source)."""
+    spec = args.data
+    m = re.fullmatch(r"synthetic:(\d+)x(\d+)(?:c(\d+))?", spec)
+    if m:
+        from mpi_knn_tpu.data.synthetic import make_blobs
+
+        rows, dim, classes = int(m[1]), int(m[2]), int(m[3] or 10)
+        X, y = make_blobs(rows, dim, num_classes=classes, seed=0)
+        return X, y, spec
+    if spec == "mnist":
+        from mpi_knn_tpu.data.mnist import load_mnist
+
+        X, y, src = load_mnist(m=args.limit or 60000)
+        return X, y, f"mnist({src})"
+    from mpi_knn_tpu.data.matfile import load_corpus_mat
+
+    try:
+        X, y = load_corpus_mat(spec, limit=args.limit)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"error: --data {spec!r} is not a file, 'mnist', or a "
+            "synthetic:MxDcC spec"
+        )
+    except ValueError as e:
+        raise SystemExit(f"error: {e}")
+    return X, y, spec
+
+
+def _load_queries(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from mpi_knn_tpu.data.matfile import read_mat
+
+    data = read_mat(path)
+    for name in ("queries", "train_X"):
+        if name in data:
+            return data[name].astype(np.float32)
+    raise SystemExit(f"{path}: no queries/train_X variable")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from mpi_knn_tpu.api import all_knn, knn_classify, resolve_backend
+    from mpi_knn_tpu.utils.report import RunReport
+    from mpi_knn_tpu.utils.timing import PhaseTimer, profile_trace
+
+    timer = PhaseTimer()
+    with timer.phase("load"):
+        X, labels, source = _load_data(args)
+        if args.limit:
+            X = X[: args.limit]
+            labels = labels[: args.limit] if labels is not None else None
+
+    cfg = KNNConfig(
+        k=args.k,
+        metric=args.metric,
+        backend=args.backend,
+        num_classes=args.num_classes,
+        tie_break=args.tie_break,
+        query_tile=args.query_tile,
+        corpus_tile=args.corpus_tile,
+        dtype=args.dtype,
+        topk_method=args.topk_method,
+        exclude_zero=not args.include_zero_dist,
+        exclude_self=not args.include_self,
+        num_devices=args.devices,
+    )
+
+    queries = _load_queries(args.queries) if args.queries else None
+
+    if args.svd:
+        from mpi_knn_tpu.data.svd import svd_reduce
+
+        with timer.phase("svd"):
+            X_red, comps, mu = svd_reduce(X, args.svd)
+            timer.block_on(X_red)
+            X = np.asarray(X_red)
+            if queries is not None:
+                # project queries into the same principal subspace
+                queries = (queries - np.asarray(mu)) @ np.asarray(comps)
+
+    report = RunReport(
+        config=vars(args),
+        data_source=source,
+        shape=tuple(X.shape),
+        backend=resolve_backend(cfg),
+        num_devices=cfg.num_devices or 1,
+    )
+
+    with profile_trace(args.profile):
+        with timer.phase("knn"):
+            if args.checkpoint_dir:
+                from mpi_knn_tpu.backends.resumable import all_knn_resumable
+                from mpi_knn_tpu.types import KNNResult
+
+                q_arr = queries if queries is not None else X
+                q_ids = (
+                    np.full(len(q_arr), -1, np.int32)
+                    if queries is not None
+                    else np.arange(len(X), dtype=np.int32)
+                )
+                d, i = all_knn_resumable(
+                    X, q_arr, q_ids, cfg,
+                    checkpoint_dir=args.checkpoint_dir,
+                    save_every=args.save_every,
+                )
+                result = KNNResult(dists=d, ids=i)
+            else:
+                result = all_knn(X, queries=queries, config=cfg)
+            timer.block_on(result.dists)
+
+        do_vote = labels is not None and (args.loo or queries is None)
+        cls = None
+        if do_vote:
+            with timer.phase("vote"):
+                cls = knn_classify(
+                    result, labels, num_classes=args.num_classes,
+                    tie_break=args.tie_break,
+                )
+                timer.block_on(cls.predictions)
+            if queries is None:
+                report.matches = int(cls.matches(labels))
+                report.total = int(len(labels))
+                report.accuracy = report.matches / report.total
+            else:
+                # query mode: the predictions ARE the output
+                preds = np.asarray(cls.predictions)
+                report.notes["predictions"] = preds.tolist()
+
+    report.phase_seconds = dict(timer.seconds)
+
+    if not args.quiet:
+        # reference-parity lines (knn-serial.c:98,130) plus a real summary
+        print(f"Clock time = {timer.seconds['knn']:.6f}")
+        if report.matches is not None:
+            print(f"Matches: {report.matches}")
+        if cls is not None and queries is not None:
+            preds = np.asarray(cls.predictions)
+            print(f"predictions ({len(preds)} queries): {preds[:20].tolist()}"
+                  + (" ..." if len(preds) > 20 else ""))
+        print(
+            f"[mpi_knn_tpu] backend={report.backend} shape={report.shape} "
+            f"k={args.k} metric={args.metric} "
+            + (f"accuracy={report.accuracy:.4f} " if report.accuracy else "")
+            + f"knn={timer.seconds['knn']:.3f}s"
+        )
+        if args.one_based_ids:
+            ids = np.asarray(result.one_based())
+            print("neighbor ids (1-based, first 5 queries):")
+            print(ids[:5])
+
+    if args.report:
+        report.save(args.report)
+        if not args.quiet:
+            print(f"report written to {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

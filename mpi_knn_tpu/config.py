@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
-BACKENDS = ("auto", "serial", "ring", "ring-overlap")
+BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
 TOPK_METHODS = ("exact", "approx")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")

@@ -1,0 +1,296 @@
+"""MAT v5 file I/O — the framework's replacement for the reference's MATLAB
+libmat/libmx data layer (SURVEY.md C1, ``/root/reference/knn-serial.c:38-52``).
+
+Two readers with identical semantics:
+
+- **native**: ``native/matio.cpp``, a clean-room C++ parser of the public
+  MAT-File Level 5 format (zlib miCOMPRESSED supported), built on demand with
+  the repo Makefile and bound via ctypes — mirroring the reference's use of a
+  native I/O library, without the MATLAB Runtime dependency.
+- **numpy fallback**: a pure-Python parser of the same format for
+  environments without a C++ toolchain.
+
+Plus a writer (used by tests, MNIST conversion, and checkpointing of derived
+corpora). All variables are 2-D numeric arrays, stored column-major per the
+format; values are returned as float64 like ``mxGetPr`` yields.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import zlib
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libtknn_matio.so"
+
+# MAT v5 data-type tags / array classes
+_MI_INT8, _MI_UINT8, _MI_INT16, _MI_UINT16 = 1, 2, 3, 4
+_MI_INT32, _MI_UINT32, _MI_SINGLE, _MI_DOUBLE = 5, 6, 7, 9
+_MI_INT64, _MI_UINT64, _MI_MATRIX, _MI_COMPRESSED = 12, 13, 14, 15
+
+_MI_DTYPES = {
+    _MI_INT8: np.int8,
+    _MI_UINT8: np.uint8,
+    _MI_INT16: np.int16,
+    _MI_UINT16: np.uint16,
+    _MI_INT32: np.int32,
+    _MI_UINT32: np.uint32,
+    _MI_SINGLE: np.float32,
+    _MI_DOUBLE: np.float64,
+    _MI_INT64: np.int64,
+    _MI_UINT64: np.uint64,
+}
+
+_CLASS_FOR_DTYPE = {
+    np.dtype(np.float64): (6, _MI_DOUBLE),
+    np.dtype(np.float32): (7, _MI_SINGLE),
+    np.dtype(np.int8): (8, _MI_INT8),
+    np.dtype(np.uint8): (9, _MI_UINT8),
+    np.dtype(np.int16): (10, _MI_INT16),
+    np.dtype(np.uint16): (11, _MI_UINT16),
+    np.dtype(np.int32): (12, _MI_INT32),
+    np.dtype(np.uint32): (13, _MI_UINT32),
+    np.dtype(np.int64): (14, _MI_INT64),
+    np.dtype(np.uint64): (15, _MI_UINT64),
+}
+
+
+# ---------------------------------------------------------------- writer
+
+
+def _element(mi_type: int, payload: bytes) -> bytes:
+    """Tagged element in the normal (non-packed) format, 8-byte padded —
+    except miCOMPRESSED, which MATLAB writes unpadded (readers advance by the
+    exact byte count; padding here shifts every following element)."""
+    pad = 0 if mi_type == _MI_COMPRESSED else (-len(payload)) % 8
+    return struct.pack("<II", mi_type, len(payload)) + payload + b"\0" * pad
+
+
+def write_mat(path, variables: Dict[str, np.ndarray], compress: bool = True):
+    """Write 2-D numeric arrays as a MAT v5 file (column-major on disk)."""
+    out = bytearray()
+    header_text = b"MATLAB 5.0 MAT-file, written by mpi_knn_tpu"
+    out += header_text + b" " * (116 - len(header_text))
+    out += b"\0" * 8  # subsystem data offset
+    out += struct.pack("<HH", 0x0100, 0x4D49)  # version, 'IM' endianness
+
+    for name, arr in variables.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 1:
+            arr = arr[:, None]
+        if arr.ndim != 2:
+            raise ValueError(f"{name}: only 1-D/2-D arrays supported")
+        if arr.dtype not in _CLASS_FOR_DTYPE:
+            raise ValueError(f"{name}: unsupported dtype {arr.dtype}")
+        cls, mi_type = _CLASS_FOR_DTYPE[arr.dtype]
+
+        flags = _element(_MI_UINT32, struct.pack("<II", cls, 0))
+        dims = _element(_MI_INT32, struct.pack("<ii", *arr.shape))
+        name_el = _element(_MI_INT8, name.encode())
+        data = _element(mi_type, arr.T.tobytes())  # column-major
+        matrix = _element(_MI_MATRIX, flags + dims + name_el + data)
+
+        if compress:
+            out += _element(_MI_COMPRESSED, zlib.compress(matrix))
+        else:
+            out += matrix
+
+    Path(path).write_bytes(bytes(out))
+
+
+# ---------------------------------------------------------------- numpy reader
+
+
+def _read_tag(buf: memoryview, off: int):
+    """Returns (mi_type, nbytes, data_off, next_off) handling the packed
+    small-element form (payload <= 4 bytes inside the tag)."""
+    (w0,) = struct.unpack_from("<I", buf, off)
+    if w0 >> 16:
+        return w0 & 0xFFFF, w0 >> 16, off + 4, off + 8
+    (nbytes,) = struct.unpack_from("<I", buf, off + 4)
+    data_off = off + 8
+    if w0 == _MI_COMPRESSED:
+        next_off = data_off + nbytes  # compressed elements are never padded
+    else:
+        next_off = data_off + ((nbytes + 7) & ~7)
+        if next_off > len(buf):  # final element may omit padding
+            next_off = data_off + nbytes
+    return w0, nbytes, data_off, next_off
+
+
+def _parse_matrix(buf: memoryview) -> Optional[tuple]:
+    off = 0
+    mi, nb, doff, off = _read_tag(buf, off)
+    if mi != _MI_UINT32 or nb < 8:
+        return None
+    (flags,) = struct.unpack_from("<I", buf, doff)
+    cls = flags & 0xFF
+    if not (6 <= cls <= 15):
+        return None  # non-numeric class (cell/struct/char/sparse)
+
+    mi, nb, doff, off = _read_tag(buf, off)
+    if mi != _MI_INT32:
+        return None
+    dims = np.frombuffer(buf, np.int32, count=nb // 4, offset=doff)
+
+    mi, nb, doff, off = _read_tag(buf, off)
+    if mi != _MI_INT8:
+        return None
+    name = bytes(buf[doff : doff + nb]).decode()
+
+    mi, nb, doff, off = _read_tag(buf, off)
+    if mi not in _MI_DTYPES:
+        return None
+    raw = np.frombuffer(buf, _MI_DTYPES[mi], count=nb // np.dtype(_MI_DTYPES[mi]).itemsize, offset=doff)
+    arr = raw.astype(np.float64).reshape(tuple(dims), order="F")
+    return name, arr
+
+
+def read_mat_numpy(path) -> Dict[str, np.ndarray]:
+    buf = memoryview(Path(path).read_bytes())
+    if len(buf) < 128:
+        raise ValueError(f"{path}: not a MAT v5 file (too short)")
+    (endian,) = struct.unpack_from("<H", buf, 126)
+    if endian != 0x4D49:
+        raise ValueError(f"{path}: big-endian MAT files unsupported")
+
+    out: Dict[str, np.ndarray] = {}
+    off = 128
+    while off + 8 <= len(buf):
+        mi, nb, doff, off = _read_tag(buf, off)
+        if mi == _MI_COMPRESSED:
+            inner = memoryview(zlib.decompress(buf[doff : doff + nb]))
+            imi, inb, idoff, _ = _read_tag(inner, 0)
+            if imi != _MI_MATRIX:
+                continue
+            parsed = _parse_matrix(inner[idoff : idoff + inb])
+        elif mi == _MI_MATRIX:
+            parsed = _parse_matrix(buf[doff : doff + nb])
+        else:
+            parsed = None  # skip non-matrix top-level elements
+        if parsed:
+            out[parsed[0]] = parsed[1]
+    return out
+
+
+# ---------------------------------------------------------------- native reader
+
+_native_lib = None
+_native_build_failed = False
+
+
+def load_native_lib(build: bool = True):
+    """Load (building if needed) the C++ MAT reader; None if unavailable."""
+    global _native_lib, _native_build_failed
+    if _native_lib is not None:
+        return _native_lib
+    if _native_build_failed:
+        return None
+    if not _LIB_PATH.exists() and build:
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError):
+            _native_build_failed = True
+            return None
+    if not _LIB_PATH.exists():
+        _native_build_failed = True
+        return None
+
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.tknn_mat_open.restype = ctypes.c_void_p
+    lib.tknn_mat_open.argtypes = [ctypes.c_char_p]
+    lib.tknn_mat_error.restype = ctypes.c_char_p
+    lib.tknn_mat_error.argtypes = [ctypes.c_void_p]
+    lib.tknn_mat_num_vars.restype = ctypes.c_int
+    lib.tknn_mat_num_vars.argtypes = [ctypes.c_void_p]
+    lib.tknn_mat_var_name.restype = ctypes.c_char_p
+    lib.tknn_mat_var_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tknn_mat_var_shape.restype = ctypes.c_int
+    lib.tknn_mat_var_shape.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
+    lib.tknn_mat_read_f64.restype = ctypes.c_int64
+    lib.tknn_mat_read_f64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.tknn_mat_close.restype = None
+    lib.tknn_mat_close.argtypes = [ctypes.c_void_p]
+    _native_lib = lib
+    return lib
+
+
+def read_mat_native(path) -> Dict[str, np.ndarray]:
+    lib = load_native_lib()
+    if lib is None:
+        raise RuntimeError("native MAT reader unavailable (build failed?)")
+    h = lib.tknn_mat_open(str(path).encode())
+    try:
+        err = lib.tknn_mat_error(h).decode()
+        if err:
+            raise ValueError(f"{path}: {err}")
+        out: Dict[str, np.ndarray] = {}
+        for i in range(lib.tknn_mat_num_vars(h)):
+            name = lib.tknn_mat_var_name(h, i).decode()
+            dims = (ctypes.c_int64 * 8)()
+            nd = lib.tknn_mat_var_shape(h, name.encode(), dims, 8)
+            shape = tuple(dims[j] for j in range(nd))
+            buf = np.empty(int(np.prod(shape)) if shape else 0, dtype=np.float64)
+            n = lib.tknn_mat_read_f64(
+                h, name.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            )
+            if n != buf.size:
+                raise ValueError(f"{path}: size mismatch reading {name!r}")
+            out[name] = buf.reshape(shape, order="F")
+        return out
+    finally:
+        lib.tknn_mat_close(h)
+
+
+def read_mat(path, prefer_native: bool = True) -> Dict[str, np.ndarray]:
+    """Read all numeric 2-D variables from a MAT v5 file as float64 arrays."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if prefer_native and load_native_lib() is not None:
+        return read_mat_native(path)
+    return read_mat_numpy(path)
+
+
+def load_corpus_mat(path, limit: Optional[int] = None):
+    """Read a corpus in the reference's file layout: ``train_X`` (m × d) and
+    optional ``train_labels`` (m × 1, 1-based per the MATLAB convention,
+    ``/root/reference/knn-serial.c:118``) mapped to 0-based int32.
+
+    Returns (X float32, labels int32 | None). Single home for the layout +
+    label-convention logic (used by the MNIST loader and the CLI).
+    """
+    data = read_mat(path)
+    if "train_X" not in data:
+        raise ValueError(f"{path}: no train_X variable (found: {sorted(data)})")
+    X = data["train_X"].astype(np.float32)
+    labels = None
+    if "train_labels" in data:
+        labels = data["train_labels"].reshape(-1).astype(np.int32)
+        if labels.min() >= 1:  # reference files are 1-based
+            labels = labels - 1
+    if limit is not None:
+        X = X[:limit]
+        labels = labels[:limit] if labels is not None else None
+    return X, labels

@@ -1,0 +1,97 @@
+"""MNIST corpus loading (SURVEY.md C13).
+
+The reference hardcodes ``matOpen("mnist_train.mat")`` with variables
+``train_X`` (60000×784 float64) and ``train_labels`` (60000×1, values 1..10)
+(``/root/reference/knn-serial.c:40-52``). This loader:
+
+1. reads that exact file layout if present (path argument, ``$TKNN_MNIST``,
+   or conventional locations) via the framework's own MAT reader;
+2. reads raw IDX files (``train-images-idx3-ubyte``/``train-labels-idx1-ubyte``)
+   if found next to the .mat path;
+3. otherwise falls back to a deterministic MNIST-shaped synthetic corpus
+   (the data blobs are stripped from the reference snapshot).
+
+Labels are returned 0-based; the 1-based MAT convention is mapped at this
+boundary.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mpi_knn_tpu.data.matfile import load_corpus_mat
+from mpi_knn_tpu.data.synthetic import make_mnist_like
+
+_SEARCH_PATHS = [
+    "mnist_train.mat",
+    "data/mnist_train.mat",
+    "/root/data/mnist_train.mat",
+]
+
+
+def _load_idx_images(path: Path) -> np.ndarray:
+    op = gzip.open if path.suffix == ".gz" else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows * cols).astype(np.float32)
+
+
+def _load_idx_labels(path: Path) -> np.ndarray:
+    op = gzip.open if path.suffix == ".gz" else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int32)
+
+
+def load_mnist(
+    path: Optional[str] = None,
+    synthetic_ok: bool = True,
+    m: int = 60000,
+) -> Tuple[np.ndarray, np.ndarray, str]:
+    """Returns (X (m, 784) float32, labels (m,) int32 0-based, source).
+
+    source is one of "mat", "idx", "synthetic" so reports can state what was
+    actually measured.
+    """
+    candidates = [path] if path else []
+    candidates += [os.environ.get("TKNN_MNIST")]
+    candidates += _SEARCH_PATHS
+    for cand in candidates:
+        if not cand:
+            continue
+        p = Path(cand)
+        if p.suffix == ".mat" and p.exists():
+            X, labels = load_corpus_mat(p, limit=m)
+            if labels is None:
+                raise ValueError(f"{p}: expected a train_labels variable")
+            return X, labels, "mat"
+        if p.is_dir():
+            img = next(
+                (p / n for n in ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz") if (p / n).exists()),
+                None,
+            )
+            lab = next(
+                (p / n for n in ("train-labels-idx1-ubyte", "train-labels-idx1-ubyte.gz") if (p / n).exists()),
+                None,
+            )
+            if img and lab:
+                return _load_idx_images(img)[:m], _load_idx_labels(lab)[:m], "idx"
+    if not synthetic_ok:
+        raise FileNotFoundError(
+            "MNIST not found (searched: "
+            + ", ".join(str(c) for c in candidates if c)
+            + "); pass path= or set $TKNN_MNIST"
+        )
+    X, y = make_mnist_like(m=m)
+    return X, y, "synthetic"

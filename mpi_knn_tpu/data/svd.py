@@ -1,0 +1,47 @@
+"""On-device SVD/PCA corpus reduction — the ``mnist_train_svd.mat`` path
+(SURVEY.md C13: the reference names an SVD-reduced corpus in its blob list but
+ships no code for it; the rebuild provides the reduction itself).
+
+Computed the TPU way: instead of a full (m × d) SVD, form the d × d Gram
+matrix on the MXU (one matmul over the corpus) and eigendecompose it —
+O(m·d² + d³) with d=784, entirely on device in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("out_dim",))
+def _svd_reduce_jit(x: jax.Array, out_dim: int):
+    mu = jnp.mean(x, axis=0)
+    xc = x - mu
+    # Gram matrix on the MXU; HIGHEST precision — eigenvectors feed distances
+    gram = jax.lax.dot_general(
+        xc,
+        xc,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    eigvals, eigvecs = jnp.linalg.eigh(gram)  # ascending
+    comps = eigvecs[:, ::-1][:, :out_dim]  # top-out_dim principal directions
+    return xc @ comps, comps, mu
+
+
+def svd_reduce(x, out_dim: int):
+    """Project (m, d) points onto their top out_dim principal components.
+
+    Returns (reduced (m, out_dim) f32, components (d, out_dim), mean (d,)).
+    Distances in the reduced space approximate corpus distances; the SVD
+    benchmark configs (k ∈ {1,10,100}, BASELINE.md) run on this output.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    if not 1 <= out_dim <= x.shape[1]:
+        raise ValueError(f"out_dim must be in [1, {x.shape[1]}], got {out_dim}")
+    reduced, comps, mu = _svd_reduce_jit(x, out_dim)
+    return reduced, comps, mu

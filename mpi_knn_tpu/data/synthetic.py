@@ -1,0 +1,38 @@
+"""Deterministic synthetic corpora for tests and benchmarks (SURVEY.md C13:
+the reference's datasets are stripped from its snapshot, so the framework
+ships generators with the same shapes)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_blobs(
+    m: int,
+    d: int,
+    num_classes: int = 10,
+    seed: int = 0,
+    center_scale: float = 4.0,
+    noise: float = 1.0,
+    dtype=np.float32,
+):
+    """Gaussian class blobs: (X (m, d), labels (m,) 0-based int32)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((num_classes, d)) * center_scale
+    y = rng.integers(0, num_classes, size=m).astype(np.int32)
+    X = (centers[y] + rng.standard_normal((m, d)) * noise).astype(dtype)
+    return X, y
+
+
+def make_mnist_like(m: int = 60000, d: int = 784, seed: int = 0):
+    """MNIST-shaped surrogate: 10 classes, pixel-like values in [0, 255].
+
+    Used when the real ``mnist_train.mat`` is absent (it is stripped from the
+    reference snapshot, ``.MISSING_LARGE_BLOBS:1-2``). Marked synthetic in
+    run reports.
+    """
+    rng = np.random.default_rng(seed)
+    centers = rng.random((10, d)) * 255.0
+    y = rng.integers(0, 10, size=m).astype(np.int32)
+    X = centers[y] + rng.standard_normal((m, d)) * 25.0
+    return np.clip(X, 0.0, 255.0).astype(np.float32), y

@@ -1,0 +1,175 @@
+"""Fused distance + top-k Pallas kernel (SURVEY.md §8 step 5).
+
+The XLA path materializes each (query_tile × corpus_tile) distance block in
+HBM between the matmul and the top_k. This kernel keeps the block in VMEM:
+one MXU matmul computes ``q_sq + c_sq − 2·Q·Cᵀ`` for the tile, masking and a
+k-pass iterative min-extraction run on the VPU, and only the (q_tile, k)
+survivors leave chip memory — an O(corpus_tile/k) reduction in HBM traffic
+for the selection phase.
+
+Per grid cell (qi, ci) the kernel emits that corpus tile's local top-k into
+an (n_c, Q, k) output; the cheap cross-tile merge (k·n_c candidates per
+query) stays in XLA (ops.topk.smallest_k). Global candidate ids are derived
+from ``pl.program_id`` + iota (no id operands — Mosaic block shapes stay
+MXU/VPU-aligned). Runs compiled on TPU (Mosaic), interpreted elsewhere, so CI
+exercises the same kernel body on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_knn_tpu.types import INVALID_ID
+
+_ZERO_RTOL = 1e-6  # matches ops.topk._ZERO_RTOL_DEFAULT (f32 path)
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _fused_knn_kernel(
+    q_ref,  # (q_tile, d) queries
+    c_ref,  # (c_tile, d) corpus tile
+    outd_ref,  # (1, q_tile, k) tile-local k smallest distances
+    outi_ref,  # (1, q_tile, k) their global corpus ids
+    *,
+    k: int,
+    q_tile: int,
+    c_tile: int,
+    m_corpus: int,  # real (unpadded) corpus rows; >= id means padding
+    exclude_self: bool,
+    exclude_zero: bool,
+    all_pairs: bool,
+    zero_eps: float,  # >0: absolute threshold; 0: relative (rtol · scale)
+    precision,
+):
+    qi = pl.program_id(0)
+    ci = pl.program_id(1)
+
+    q = q_ref[:]
+    c = c_ref[:]
+    q_sq = jnp.sum(q * q, axis=-1, keepdims=True)  # (q_tile, 1)
+    c_sq = jnp.sum(c * c, axis=-1, keepdims=True).T  # (1, c_tile)
+    # MXU: one matmul per tile; f32 accumulation
+    xy = jax.lax.dot_general(
+        q,
+        c,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    d = jnp.maximum(q_sq - 2.0 * xy + c_sq, 0.0)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, (q_tile, c_tile), 1)
+    col_global = ci * c_tile + col  # candidate global ids
+    invalid = col_global >= m_corpus  # divisibility padding rows
+    if exclude_zero:
+        # same semantics as ops.topk.mask_tile: explicit absolute eps wins,
+        # else relative to the pair magnitude
+        thresh = zero_eps if zero_eps > 0.0 else _ZERO_RTOL * (q_sq + c_sq)
+        invalid = invalid | (d <= thresh)
+    if exclude_self and all_pairs:
+        row = jax.lax.broadcasted_iota(jnp.int32, (q_tile, c_tile), 0)
+        row_global = qi * q_tile + row  # query global ids (all-pairs mode)
+        invalid = invalid | (col_global == row_global)
+    d = jnp.where(invalid, jnp.inf, d)
+
+    # k-pass min extraction on the VPU: find each row's minimum, record it,
+    # knock it out, repeat — the in-register replacement for qsort-per-insert
+    dists_out = []
+    ids_out = []
+    for _ in range(k):
+        row_min = jnp.min(d, axis=1, keepdims=True)  # (q_tile, 1)
+        # leftmost column attaining the min (stable tie-break, matching the
+        # reference's first-encountered-wins scan order)
+        is_min = d == row_min
+        first_col = jnp.min(
+            jnp.where(is_min, col, _I32_MAX), axis=1, keepdims=True
+        )
+        hit = col == first_col
+        ids_j = jnp.max(jnp.where(hit, col_global, INVALID_ID), axis=1)
+        dists_out.append(row_min[:, 0])
+        ids_out.append(jnp.where(jnp.isinf(row_min[:, 0]), INVALID_ID, ids_j))
+        d = jnp.where(hit, jnp.inf, d)
+
+    outd_ref[0] = jnp.stack(dists_out, axis=1)
+    outi_ref[0] = jnp.stack(ids_out, axis=1)
+
+
+def fused_knn_tiles(
+    queries: jax.Array,  # (Q, d), Q % q_tile == 0 (padded)
+    corpus: jax.Array,  # (C, d), C % c_tile == 0 (padded)
+    m_corpus: int,  # real corpus rows (<= C)
+    k: int,
+    q_tile: int,
+    c_tile: int,
+    exclude_self: bool = True,
+    exclude_zero: bool = True,
+    all_pairs: bool = True,
+    zero_eps: float = 0.0,
+    precision=None,
+    interpret: bool | None = None,
+):
+    """Per-(query-tile, corpus-tile) local top-k.
+
+    Returns (Q, n_c·k) dists and global ids, ready for one cross-tile merge.
+    """
+    Q, dim = queries.shape
+    C = corpus.shape[0]
+    if Q % q_tile or C % c_tile:
+        raise ValueError("caller must pad to tile multiples")
+    if k > c_tile:
+        raise ValueError(f"k={k} exceeds corpus_tile={c_tile}")
+    n_q, n_c = Q // q_tile, C // c_tile
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(
+        _fused_knn_kernel,
+        k=k,
+        q_tile=q_tile,
+        c_tile=c_tile,
+        m_corpus=m_corpus,
+        exclude_self=exclude_self,
+        exclude_zero=exclude_zero,
+        all_pairs=all_pairs,
+        zero_eps=zero_eps,
+        # recall-parity anchor, same as ops.distance: full f32 by default
+        precision=(
+            jax.lax.Precision.HIGHEST if precision is None else precision
+        ),
+    )
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid=(n_q, n_c),
+        in_specs=[
+            pl.BlockSpec(
+                (q_tile, dim), lambda qi, ci: (qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (c_tile, dim), lambda qi, ci: (ci, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            # block's trailing dims (q_tile, k) match the array's -> no
+            # lane-alignment constraint on k
+            pl.BlockSpec(
+                (1, q_tile, k), lambda qi, ci: (ci, qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, q_tile, k), lambda qi, ci: (ci, qi, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_c, Q, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_c, Q, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries.astype(jnp.float32), corpus.astype(jnp.float32))
+    # (n_c, Q, k) -> (Q, n_c·k) candidate lists per query
+    outd = jnp.transpose(outd, (1, 0, 2)).reshape(Q, n_c * k)
+    outi = jnp.transpose(outi, (1, 0, 2)).reshape(Q, n_c * k)
+    return outd, outi

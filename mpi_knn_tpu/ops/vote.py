@@ -83,6 +83,7 @@ def vote(
     """
     counts = vote_counts(neigh_labels, valid, num_classes)
     nearest = jnp.where(valid[:, 0], neigh_labels[:, 0], 0).astype(jnp.int32)
+    any_valid = jnp.any(valid, axis=-1)
 
     if tie_break == "quirk-serial":
         pred = _quirk_vote(counts, nearest)
@@ -102,6 +103,9 @@ def vote(
         else:
             raise ValueError(f"unknown tie_break {tie_break!r}")
 
+    # a query whose every neighbor slot is invalid has no evidence at all —
+    # emit the sentinel −1 rather than a confident class 0
+    pred = jnp.where(any_valid, pred, jnp.int32(-1))
     return ClassifyResult(predictions=pred, counts=counts)
 
 

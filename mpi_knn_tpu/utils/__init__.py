@@ -1,0 +1,4 @@
+from mpi_knn_tpu.utils.timing import PhaseTimer
+from mpi_knn_tpu.utils.report import RunReport
+
+__all__ = ["PhaseTimer", "RunReport"]

@@ -1,0 +1,88 @@
+"""Round-granular checkpoint/resume (SURVEY.md §6 "Checkpoint / resume").
+
+The reference loses everything on a kill — results only ever reach stdout
+(``/root/reference/knn-serial.c:130``). Here the all-kNN carry (per-query
+top-k dists/ids) plus the corpus-tile cursor is saved every R rounds; a
+restarted run validates the fingerprint (shapes, config, cheap corpus
+checksum) and continues from the saved round instead of recomputing.
+
+Files are NPZ, written atomically (tmp + rename) so a crash mid-save leaves
+the previous checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from mpi_knn_tpu.config import KNNConfig
+
+_STATE_FILE = "knn_state.npz"
+
+
+def fingerprint(corpus: np.ndarray, queries: np.ndarray, cfg: KNNConfig) -> str:
+    """Cheap, stable identity of (data, config): shapes + strided samples +
+    config fields. Not cryptographic — guards against resuming with the
+    wrong data/config, not against adversaries."""
+    h = hashlib.sha256()
+    h.update(json.dumps(dataclasses.asdict(cfg), sort_keys=True).encode())
+    for arr in (corpus, queries):
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        flat = arr.reshape(-1)
+        step = max(1, flat.size // 4096)
+        h.update(np.ascontiguousarray(flat[::step]).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class KNNCheckpoint:
+    carry_d: np.ndarray  # (QT, q_tile, k)
+    carry_i: np.ndarray  # (QT, q_tile, k)
+    tiles_done: int  # corpus tiles already merged into the carry
+    fingerprint: str
+
+
+def save_checkpoint(ckpt_dir, state: KNNCheckpoint):
+    d = Path(ckpt_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    tmp = d / (_STATE_FILE + ".tmp")
+    np.savez(
+        tmp,
+        carry_d=state.carry_d,
+        carry_i=state.carry_i,
+        tiles_done=np.int64(state.tiles_done),
+        fingerprint=np.frombuffer(state.fingerprint.encode(), dtype=np.uint8),
+    )
+    # np.savez appends .npz to the filename it is given
+    os.replace(str(tmp) + ".npz", d / _STATE_FILE)
+
+
+def load_checkpoint(ckpt_dir, expect_fingerprint: str) -> Optional[KNNCheckpoint]:
+    """Returns the saved state, or None if absent/mismatched."""
+    path = Path(ckpt_dir) / _STATE_FILE
+    if not path.exists():
+        return None
+    with np.load(path) as z:
+        fp = z["fingerprint"].tobytes().decode()
+        if fp != expect_fingerprint:
+            return None
+        return KNNCheckpoint(
+            carry_d=z["carry_d"],
+            carry_i=z["carry_i"],
+            tiles_done=int(z["tiles_done"]),
+            fingerprint=fp,
+        )
+
+
+def clear_checkpoint(ckpt_dir):
+    path = Path(ckpt_dir) / _STATE_FILE
+    if path.exists():
+        path.unlink()

@@ -1,0 +1,66 @@
+"""Structured run reports (SURVEY.md §6 "Metrics / logging").
+
+The reference's only outputs are three printfs — matches, a timing line, and
+per-round debug DONEs (``/root/reference/knn-serial.c:98,130``). The rebuild
+emits one JSON document per run: configuration, data provenance, per-phase
+seconds, accuracy/matches, and recall against a baseline when one is given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import platform
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def recall_at_k(got_ids: np.ndarray, want_ids: np.ndarray) -> float:
+    """Mean fraction of baseline neighbors recovered, per query (ignores
+    order; ignores invalid (-1) baseline slots)."""
+    got_ids = np.asarray(got_ids)
+    want_ids = np.asarray(want_ids)
+    hits, total = 0, 0
+    for g, w in zip(got_ids, want_ids):
+        wset = set(int(x) for x in w if x >= 0)
+        if not wset:
+            continue
+        hits += len(wset & set(int(x) for x in g))
+        total += len(wset)
+    return hits / total if total else 1.0
+
+
+@dataclasses.dataclass
+class RunReport:
+    """One all-kNN run, serializable to a single JSON object."""
+
+    config: Dict[str, Any]
+    data_source: str
+    shape: tuple
+    phase_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
+    matches: Optional[int] = None
+    total: Optional[int] = None
+    accuracy: Optional[float] = None
+    recall_vs_baseline: Optional[float] = None
+    backend: Optional[str] = None
+    num_devices: int = 1
+    notes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def finalize(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["environment"] = {
+            "jax_version": jax.__version__,
+            "platform": jax.default_backend(),
+            "devices": [str(x) for x in jax.devices()],
+            "host": platform.node(),
+        }
+        return d
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.finalize(), indent=indent, default=str)
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json())

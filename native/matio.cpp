@@ -1,0 +1,387 @@
+// TPU-native framework's host data layer: a clean-room MAT v5 reader.
+//
+// Replaces the reference's dependency on MATLAB's proprietary libmat/libmx
+// (matOpen/matGetVariable/mxGetPr, /root/reference/knn-serial.c:38-52) with a
+// small self-contained C++ library reading the public MAT-File Level 5 format:
+// 128-byte header, then a sequence of tagged data elements; variables are
+// miMATRIX elements (optionally zlib-wrapped in miCOMPRESSED) holding
+// [array-flags, dimensions, name, real data] sub-elements, column-major.
+//
+// Exposed as a C ABI for the ctypes binding in mpi_knn_tpu/data/matfile.py.
+// All numeric classes are converted to float64 on read (the reference's
+// convention: mxGetPr always yields double).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+// MAT v5 data type tags
+enum MiType : uint32_t {
+  miINT8 = 1,
+  miUINT8 = 2,
+  miINT16 = 3,
+  miUINT16 = 4,
+  miINT32 = 5,
+  miUINT32 = 6,
+  miSINGLE = 7,
+  miDOUBLE = 9,
+  miINT64 = 12,
+  miUINT64 = 13,
+  miMATRIX = 14,
+  miCOMPRESSED = 15,
+  miUTF8 = 16,
+};
+
+size_t mi_type_size(uint32_t t) {
+  switch (t) {
+    case miINT8:
+    case miUINT8:
+    case miUTF8:
+      return 1;
+    case miINT16:
+    case miUINT16:
+      return 2;
+    case miINT32:
+    case miUINT32:
+    case miSINGLE:
+      return 4;
+    case miDOUBLE:
+    case miINT64:
+    case miUINT64:
+      return 8;
+    default:
+      return 0;
+  }
+}
+
+struct Variable {
+  std::string name;
+  std::vector<int64_t> dims;  // column-major
+  std::vector<double> data;   // converted to f64, column-major order
+};
+
+struct MatFile {
+  std::vector<Variable> vars;
+  std::string error;
+};
+
+struct Cursor {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+
+  bool need(size_t k) const { return off + k <= n; }
+  const uint8_t* at() const { return p + off; }
+};
+
+bool convert_numeric(uint32_t type, const uint8_t* src, size_t nbytes,
+                     std::vector<double>* out) {
+  size_t esz = mi_type_size(type);
+  if (esz == 0) return false;
+  size_t count = nbytes / esz;
+  out->resize(count);
+  switch (type) {
+    case miINT8: {
+      auto* s = reinterpret_cast<const int8_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miUINT8:
+    case miUTF8: {
+      for (size_t i = 0; i < count; i++) (*out)[i] = src[i];
+      break;
+    }
+    case miINT16: {
+      auto* s = reinterpret_cast<const int16_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miUINT16: {
+      auto* s = reinterpret_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miINT32: {
+      auto* s = reinterpret_cast<const int32_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miUINT32: {
+      auto* s = reinterpret_cast<const uint32_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miSINGLE: {
+      auto* s = reinterpret_cast<const float*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miDOUBLE: {
+      auto* s = reinterpret_cast<const double*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = s[i];
+      break;
+    }
+    case miINT64: {
+      auto* s = reinterpret_cast<const int64_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = static_cast<double>(s[i]);
+      break;
+    }
+    case miUINT64: {
+      auto* s = reinterpret_cast<const uint64_t*>(src);
+      for (size_t i = 0; i < count; i++) (*out)[i] = static_cast<double>(s[i]);
+      break;
+    }
+    default:
+      return false;
+  }
+  return true;
+}
+
+// Reads one sub-element tag (handling the packed "small data element" form
+// where payloads <= 4 bytes live inside the 8-byte tag itself). Returns false
+// on truncation. After return: *type/*nbytes describe the payload at *data;
+// cursor advanced past the element incl. 8-byte padding.
+bool read_element(Cursor* c, uint32_t* type, uint32_t* nbytes,
+                  const uint8_t** data) {
+  if (!c->need(8)) return false;
+  uint32_t w0, w1;
+  memcpy(&w0, c->at(), 4);
+  memcpy(&w1, c->at() + 4, 4);
+  if (w0 >> 16) {
+    // small element: high 16 bits = byte count, low 16 = type, data in w1
+    *type = w0 & 0xFFFF;
+    *nbytes = w0 >> 16;
+    if (*nbytes > 4) return false;
+    *data = c->at() + 4;
+    c->off += 8;
+    return true;
+  }
+  *type = w0;
+  *nbytes = w1;
+  c->off += 8;
+  if (!c->need(*nbytes)) return false;
+  *data = c->at();
+  size_t adv;
+  if (*type == miCOMPRESSED) {
+    adv = *nbytes;  // compressed elements are never padded
+  } else {
+    adv = (*nbytes + 7) & ~size_t(7);  // others pad to 8-byte boundary
+    size_t remaining = c->n - c->off;
+    if (adv > remaining) adv = *nbytes;  // last element may omit pad
+  }
+  c->off += adv;
+  return true;
+}
+
+bool parse_matrix(const uint8_t* p, size_t n, Variable* var,
+                  std::string* error) {
+  Cursor c{p, n};
+  uint32_t type, nbytes;
+  const uint8_t* data;
+
+  // 1. array flags (miUINT32 x2): class in the low byte of the first word
+  if (!read_element(&c, &type, &nbytes, &data) || type != miUINT32 ||
+      nbytes < 8) {
+    *error = "bad array flags";
+    return false;
+  }
+  uint32_t flags;
+  memcpy(&flags, data, 4);
+  uint32_t cls = flags & 0xFF;
+  bool is_complex = (flags >> 11) & 1;
+  // numeric classes mxDOUBLE(6) mxSINGLE(7) mxINT8(8)..mxUINT64(15); skip
+  // cell/struct/object/char/sparse (1..5) — not needed for point matrices
+  if (cls < 6 || cls > 15) {
+    *error = "unsupported array class " + std::to_string(cls);
+    return false;
+  }
+
+  // 2. dimensions (miINT32)
+  if (!read_element(&c, &type, &nbytes, &data) || type != miINT32) {
+    *error = "bad dimensions";
+    return false;
+  }
+  size_t ndim = nbytes / 4;
+  var->dims.resize(ndim);
+  int64_t total = ndim ? 1 : 0;
+  for (size_t i = 0; i < ndim; i++) {
+    int32_t d;
+    memcpy(&d, data + 4 * i, 4);
+    var->dims[i] = d;
+    total *= d;
+  }
+
+  // 3. name (miINT8)
+  if (!read_element(&c, &type, &nbytes, &data) || type != miINT8) {
+    *error = "bad name";
+    return false;
+  }
+  var->name.assign(reinterpret_cast<const char*>(data), nbytes);
+
+  // 4. real part
+  if (!read_element(&c, &type, &nbytes, &data)) {
+    *error = "bad data element";
+    return false;
+  }
+  if (!convert_numeric(type, data, nbytes, &var->data)) {
+    *error = "unsupported data type " + std::to_string(type);
+    return false;
+  }
+  if (static_cast<int64_t>(var->data.size()) != total) {
+    *error = "element count mismatch";
+    return false;
+  }
+  if (is_complex) {
+    // imaginary part ignored (real point matrices only), but not an error
+  }
+  return true;
+}
+
+bool inflate_buf(const uint8_t* src, size_t n, std::vector<uint8_t>* out) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit(&zs) != Z_OK) return false;
+  out->clear();
+  out->resize(n * 4 + 1024);
+  zs.next_in = const_cast<Bytef*>(src);
+  zs.avail_in = static_cast<uInt>(n);
+  int ret = Z_OK;
+  size_t written = 0;
+  while (ret != Z_STREAM_END) {
+    if (written == out->size()) out->resize(out->size() * 2);
+    zs.next_out = out->data() + written;
+    zs.avail_out = static_cast<uInt>(out->size() - written);
+    ret = inflate(&zs, Z_NO_FLUSH);
+    if (ret != Z_OK && ret != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    written = out->size() - zs.avail_out;
+    if (ret == Z_OK && zs.avail_in == 0 && zs.avail_out > 0) break;  // truncated
+  }
+  out->resize(written);
+  inflateEnd(&zs);
+  return ret == Z_STREAM_END;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tknn_mat_open(const char* path) {
+  auto* mf = new MatFile();
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    mf->error = "cannot open file";
+    return mf;
+  }
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf(sz);
+  if (fread(buf.data(), 1, sz, f) != static_cast<size_t>(sz)) {
+    fclose(f);
+    mf->error = "short read";
+    return mf;
+  }
+  fclose(f);
+
+  if (sz < 128) {
+    mf->error = "not a MAT v5 file (too short)";
+    return mf;
+  }
+  uint16_t version, endian;
+  memcpy(&version, buf.data() + 124, 2);
+  memcpy(&endian, buf.data() + 126, 2);
+  if (endian != 0x4D49) {  // 'IM' read little-endian
+    mf->error = "big-endian MAT files unsupported";
+    return mf;
+  }
+
+  Cursor c{buf.data(), static_cast<size_t>(sz)};
+  c.off = 128;
+  while (c.off + 8 <= c.n) {
+    uint32_t type, nbytes;
+    const uint8_t* data;
+    size_t elem_start = c.off;
+    if (!read_element(&c, &type, &nbytes, &data)) break;
+
+    Variable var;
+    std::string err;
+    if (type == miCOMPRESSED) {
+      std::vector<uint8_t> raw;
+      if (!inflate_buf(data, nbytes, &raw)) {
+        mf->error = "zlib inflate failed at offset " +
+                    std::to_string(elem_start);
+        break;
+      }
+      // the decompressed payload is one full tagged element (miMATRIX)
+      Cursor ic{raw.data(), raw.size()};
+      uint32_t itype, inb;
+      const uint8_t* idata;
+      if (!read_element(&ic, &itype, &inb, &idata) || itype != miMATRIX) {
+        continue;  // skip non-matrix elements
+      }
+      if (parse_matrix(idata, inb, &var, &err)) {
+        mf->vars.push_back(std::move(var));
+      }
+    } else if (type == miMATRIX) {
+      if (parse_matrix(data, nbytes, &var, &err)) {
+        mf->vars.push_back(std::move(var));
+      }
+    }
+    // other top-level element types (e.g. subsystem data) are skipped
+  }
+  return mf;
+}
+
+const char* tknn_mat_error(void* h) {
+  auto* mf = static_cast<MatFile*>(h);
+  return mf->error.c_str();
+}
+
+int tknn_mat_num_vars(void* h) {
+  return static_cast<int>(static_cast<MatFile*>(h)->vars.size());
+}
+
+const char* tknn_mat_var_name(void* h, int i) {
+  auto* mf = static_cast<MatFile*>(h);
+  if (i < 0 || i >= static_cast<int>(mf->vars.size())) return "";
+  return mf->vars[i].name.c_str();
+}
+
+// Writes up to max_dims dimension sizes; returns ndim, or -1 if not found.
+int tknn_mat_var_shape(void* h, const char* name, int64_t* dims,
+                       int max_dims) {
+  auto* mf = static_cast<MatFile*>(h);
+  for (auto& v : mf->vars) {
+    if (v.name == name) {
+      int nd = static_cast<int>(v.dims.size());
+      for (int i = 0; i < nd && i < max_dims; i++) dims[i] = v.dims[i];
+      return nd;
+    }
+  }
+  return -1;
+}
+
+// Copies the variable's data (f64, column-major) into out; returns element
+// count, or -1 if not found.
+int64_t tknn_mat_read_f64(void* h, const char* name, double* out) {
+  auto* mf = static_cast<MatFile*>(h);
+  for (auto& v : mf->vars) {
+    if (v.name == name) {
+      memcpy(out, v.data.data(), v.data.size() * sizeof(double));
+      return static_cast<int64_t>(v.data.size());
+    }
+  }
+  return -1;
+}
+
+void tknn_mat_close(void* h) { delete static_cast<MatFile*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,171 @@
+"""Data layer: MAT v5 roundtrip (numpy + native C++ readers), MNIST loader
+fallbacks, synthetic generators, SVD reduction."""
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu.data.matfile import (
+    load_native_lib,
+    read_mat,
+    read_mat_numpy,
+    read_mat_native,
+    write_mat,
+)
+from mpi_knn_tpu.data.mnist import load_mnist
+from mpi_knn_tpu.data.synthetic import make_blobs, make_mnist_like
+from mpi_knn_tpu.data.svd import svd_reduce
+
+
+@pytest.fixture
+def sample_vars(rng):
+    return {
+        "train_X": rng.standard_normal((37, 12)),
+        "train_labels": rng.integers(1, 11, size=(37, 1)).astype(np.float64),
+        "f32_var": rng.standard_normal((5, 3)).astype(np.float32),
+        "u8_var": rng.integers(0, 256, size=(4, 6)).astype(np.uint8),
+        "i32_var": rng.integers(-100, 100, size=(3, 3)).astype(np.int32),
+        "vec": rng.standard_normal(9),
+    }
+
+
+@pytest.mark.parametrize("compress", [True, False])
+def test_mat_roundtrip_numpy(tmp_path, sample_vars, compress):
+    p = tmp_path / "t.mat"
+    write_mat(p, sample_vars, compress=compress)
+    got = read_mat_numpy(p)
+    assert set(got) == set(sample_vars)
+    for name, arr in sample_vars.items():
+        want = np.asarray(arr, dtype=np.float64)
+        if want.ndim == 1:
+            want = want[:, None]
+        np.testing.assert_array_equal(got[name], want)
+
+
+@pytest.mark.parametrize("compress", [True, False])
+def test_mat_roundtrip_native(tmp_path, sample_vars, compress):
+    if load_native_lib() is None:
+        pytest.skip("no C++ toolchain to build native reader")
+    p = tmp_path / "t.mat"
+    write_mat(p, sample_vars, compress=compress)
+    got = read_mat_native(p)
+    assert set(got) == set(sample_vars)
+    for name, arr in sample_vars.items():
+        want = np.asarray(arr, dtype=np.float64)
+        if want.ndim == 1:
+            want = want[:, None]
+        np.testing.assert_array_equal(got[name], want)
+
+
+def test_native_and_numpy_agree(tmp_path, sample_vars):
+    if load_native_lib() is None:
+        pytest.skip("no C++ toolchain to build native reader")
+    p = tmp_path / "t.mat"
+    write_mat(p, sample_vars)
+    a, b = read_mat_native(p), read_mat_numpy(p)
+    for name in sample_vars:
+        np.testing.assert_array_equal(a[name], b[name])
+
+
+def test_scipy_can_read_our_files(tmp_path, sample_vars):
+    """Cross-validation against an independent MAT v5 implementation."""
+    scipy_io = pytest.importorskip("scipy.io")
+    p = tmp_path / "t.mat"
+    write_mat(p, sample_vars)
+    got = scipy_io.loadmat(str(p))
+    np.testing.assert_allclose(
+        got["train_X"], np.asarray(sample_vars["train_X"]), rtol=0, atol=0
+    )
+
+
+def test_we_can_read_scipy_files(tmp_path, rng):
+    """And the reverse: files written by scipy (as MATLAB would) parse."""
+    scipy_io = pytest.importorskip("scipy.io")
+    p = tmp_path / "s.mat"
+    X = rng.standard_normal((20, 7))
+    labels = rng.integers(1, 11, size=(20, 1)).astype(np.float64)
+    scipy_io.savemat(str(p), {"train_X": X, "train_labels": labels})
+    got = read_mat(p)
+    np.testing.assert_array_equal(got["train_X"], X)
+    np.testing.assert_array_equal(got["train_labels"], labels)
+    if load_native_lib() is not None:
+        got_n = read_mat_native(p)
+        np.testing.assert_array_equal(got_n["train_X"], X)
+
+
+def test_column_major_layout_preserved(tmp_path):
+    """MAT stores column-major: element [i, j] must survive the transpose
+    dance exactly (the reference indexes p[r + c*m], knn-serial.c:82)."""
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    p = tmp_path / "c.mat"
+    write_mat(p, {"a": arr})
+    got = read_mat_numpy(p)["a"]
+    assert got[1, 2] == arr[1, 2]
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_read_mat_missing_file():
+    with pytest.raises(FileNotFoundError):
+        read_mat("/nonexistent/x.mat")
+
+
+def test_read_mat_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.mat"
+    p.write_bytes(b"not a mat file")
+    with pytest.raises(ValueError):
+        read_mat_numpy(p)
+
+
+def test_mnist_loads_reference_layout_mat(tmp_path, rng):
+    """A file in the exact reference layout (train_X 60000x784, 1-based
+    labels) loads with labels mapped to 0-based."""
+    X = rng.random((50, 784))
+    labels = rng.integers(1, 11, size=(50, 1)).astype(np.float64)
+    p = tmp_path / "mnist_train.mat"
+    write_mat(p, {"train_X": X, "train_labels": labels})
+    gx, gy, src = load_mnist(path=str(p), m=50)
+    assert src == "mat"
+    assert gx.shape == (50, 784) and gx.dtype == np.float32
+    np.testing.assert_array_equal(gy, labels.reshape(-1).astype(np.int32) - 1)
+
+
+def test_mnist_synthetic_fallback():
+    X, y, src = load_mnist(path=None, m=128)
+    assert src == "synthetic"
+    assert X.shape == (128, 784) and y.shape == (128,)
+    assert 0 <= y.min() and y.max() <= 9
+    # deterministic
+    X2, y2, _ = load_mnist(path=None, m=128)
+    np.testing.assert_array_equal(X, X2)
+
+
+def test_mnist_strict_mode_raises():
+    with pytest.raises(FileNotFoundError):
+        load_mnist(path=None, synthetic_ok=False)
+
+
+def test_blobs_deterministic():
+    a = make_blobs(64, 8, seed=3)
+    b = make_blobs(64, 8, seed=3)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_svd_reduce_reconstructs_low_rank(rng):
+    """Points on a true 5-D subspace: 5 components capture them exactly."""
+    basis = rng.standard_normal((5, 32))
+    coef = rng.standard_normal((200, 5))
+    X = (coef @ basis).astype(np.float32)
+    Xr, comps, mu = svd_reduce(X, 5)
+    assert Xr.shape == (200, 5) and comps.shape == (32, 5)
+    # pairwise distances preserved by projection onto the containing subspace
+    from tests.oracle import oracle_all_knn
+
+    d_full, i_full = oracle_all_knn(X, k=4)
+    d_red, i_red = oracle_all_knn(np.asarray(Xr), k=4)
+    np.testing.assert_allclose(d_red, d_full, rtol=1e-2, atol=1e-2)
+
+
+def test_svd_reduce_validates_dim(rng):
+    X = rng.standard_normal((10, 4)).astype(np.float32)
+    with pytest.raises(ValueError):
+        svd_reduce(X, 5)

@@ -1,0 +1,208 @@
+"""Harness: timing, report, checkpoint/resume, CLI."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu import KNNConfig, all_knn
+from mpi_knn_tpu.backends.resumable import all_knn_resumable
+from mpi_knn_tpu.cli import main as cli_main
+from mpi_knn_tpu.data.matfile import write_mat
+from mpi_knn_tpu.data.synthetic import make_blobs
+from mpi_knn_tpu.utils.checkpoint import load_checkpoint, fingerprint
+from mpi_knn_tpu.utils.report import RunReport, recall_at_k
+from mpi_knn_tpu.utils.timing import PhaseTimer
+
+
+# ------------------------------------------------------------------ timing
+
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert set(t.seconds) == {"a", "b"}
+    assert t.seconds["a"] >= 0
+
+
+# ------------------------------------------------------------------ report
+
+
+def test_recall_at_k_exact_and_partial():
+    got = np.array([[1, 2, 3], [4, 5, 6]])
+    want = np.array([[3, 2, 1], [4, 5, 9]])
+    assert recall_at_k(got, got) == 1.0
+    assert recall_at_k(got, want) == pytest.approx(5 / 6)
+
+
+def test_recall_ignores_invalid_baseline_slots():
+    got = np.array([[1, 2, -1]])
+    want = np.array([[1, 2, -1]])
+    assert recall_at_k(got, want) == 1.0
+
+
+def test_report_json_roundtrip(tmp_path):
+    r = RunReport(config={"k": 5}, data_source="synthetic", shape=(10, 4))
+    r.matches = 9
+    p = tmp_path / "r.json"
+    r.save(p)
+    back = json.loads(p.read_text())
+    assert back["matches"] == 9
+    assert back["environment"]["platform"] == "cpu"
+
+
+# ------------------------------------------------------------------ checkpoint
+
+
+def _resume_case(tmp_path, save_every=2):
+    X, _ = make_blobs(120, 8, seed=5)
+    cfg = KNNConfig(k=6, query_tile=16, corpus_tile=16, backend="serial")
+    qids = np.arange(len(X), dtype=np.int32)
+    return X, cfg, qids
+
+
+def test_resumable_matches_serial(tmp_path, rng):
+    X, cfg, qids = _resume_case(tmp_path)
+    d, i = all_knn_resumable(X, X, qids, cfg, checkpoint_dir=None)
+    base = all_knn(X, config=cfg)
+    # chunked execution may reassociate fp ops; ids must match exactly
+    np.testing.assert_allclose(
+        np.asarray(d), np.asarray(base.dists), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(base.ids))
+
+
+def test_checkpoint_resume_continues_not_restarts(tmp_path):
+    """Kill after round 1, resume: result identical, and the resumed run must
+    start from the saved tile cursor."""
+    X, cfg, qids = _resume_case(tmp_path)
+    ck = tmp_path / "ck"
+
+    rounds = []
+    # run only the first chunk by raising out of the progress callback
+    class Stop(Exception):
+        pass
+
+    def bail(done, total):
+        rounds.append(done)
+        raise Stop
+
+    with pytest.raises(Stop):
+        all_knn_resumable(
+            X, X, qids, cfg, checkpoint_dir=ck, save_every=3, progress_cb=bail
+        )
+    state = load_checkpoint(ck, fingerprint(X, X, cfg))
+    assert state is not None and state.tiles_done == 3
+
+    resumed_rounds = []
+    d, i = all_knn_resumable(
+        X, X, qids, cfg, checkpoint_dir=ck, save_every=3,
+        progress_cb=lambda done, total: resumed_rounds.append(done),
+    )
+    assert resumed_rounds[0] > 3  # continued, not restarted
+    base = all_knn(X, config=cfg)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(base.ids))
+
+
+def test_checkpoint_rejects_wrong_fingerprint(tmp_path):
+    X, cfg, qids = _resume_case(tmp_path)
+    ck = tmp_path / "ck"
+    all_knn_resumable(X, X, qids, cfg, checkpoint_dir=ck, save_every=2)
+    # different data -> stale checkpoint must be ignored
+    Y = X + 1.0
+    assert load_checkpoint(ck, fingerprint(Y, Y, cfg)) is None
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_synthetic_loo(tmp_path, capsys):
+    rc = cli_main(
+        [
+            "--data", "synthetic:256x16c4", "--k", "5", "--num-classes", "4",
+            "--backend", "serial", "--query-tile", "64", "--corpus-tile", "64",
+            "--report", str(tmp_path / "rep.json"),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Matches:" in out and "Clock time" in out
+    rep = json.loads((tmp_path / "rep.json").read_text())
+    assert rep["accuracy"] > 0.9
+    assert rep["backend"] == "serial"
+    assert "knn" in rep["phase_seconds"]
+
+
+def test_cli_mat_file_input(tmp_path, capsys, rng):
+    X, y = make_blobs(100, 8, num_classes=3, seed=1)
+    p = tmp_path / "corpus.mat"
+    write_mat(p, {"train_X": X.astype(np.float64),
+                  "train_labels": (y + 1)[:, None].astype(np.float64)})
+    rc = cli_main(
+        ["--data", str(p), "--k", "3", "--num-classes", "3",
+         "--backend", "serial", "--query-tile", "32", "--corpus-tile", "32"]
+    )
+    assert rc == 0
+    assert "Matches:" in capsys.readouterr().out
+
+
+def test_cli_svd_path(capsys):
+    rc = cli_main(
+        ["--data", "synthetic:128x32c4", "--svd", "8", "--k", "3",
+         "--num-classes", "4", "--backend", "serial",
+         "--query-tile", "32", "--corpus-tile", "32"]
+    )
+    assert rc == 0
+
+
+def test_cli_checkpoint_flag(tmp_path, capsys):
+    rc = cli_main(
+        ["--data", "synthetic:96x8c4", "--k", "3", "--num-classes", "4",
+         "--backend", "serial", "--query-tile", "16", "--corpus-tile", "16",
+         "--checkpoint-dir", str(tmp_path / "ck"), "--save-every", "2"]
+    )
+    assert rc == 0
+    assert (tmp_path / "ck" / "knn_state.npz").exists()
+
+
+def test_cli_svd_with_queries_projects_both(tmp_path, capsys):
+    """Regression: --svd must project the queries into the same subspace as
+    the corpus, not leave them at full dimensionality."""
+    X, y = make_blobs(128, 32, num_classes=4, seed=2)
+    qp = tmp_path / "q.npy"
+    np.save(qp, X[:7] + 0.01)
+    rc = cli_main(
+        ["--data", "synthetic:128x32c4", "--svd", "8", "--k", "3",
+         "--num-classes", "4", "--backend", "serial", "--loo",
+         "--queries", str(qp), "--query-tile", "32", "--corpus-tile", "32"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "predictions (7 queries):" in out
+
+
+def test_cli_ring_backend(capsys):
+    rc = cli_main(
+        ["--data", "synthetic:64x8c4", "--k", "3", "--num-classes", "4",
+         "--backend", "ring-overlap"]
+    )
+    assert rc == 0
+    assert "backend=ring-overlap" in capsys.readouterr().out
+
+
+def test_cli_entrypoint_subprocess():
+    """python -m mpi_knn_tpu works as a real process (CPU via --platform)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi_knn_tpu", "--data", "synthetic:64x8c4",
+         "--k", "3", "--num-classes", "4", "--backend", "serial",
+         "--platform", "cpu", "-q"],
+        capture_output=True, text=True, cwd="/root/repo", timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]

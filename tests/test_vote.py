@@ -85,6 +85,16 @@ def test_correct_vote_against_oracle(rng):
     np.testing.assert_array_equal(np.asarray(r.predictions), want)
 
 
+def test_no_valid_neighbors_yields_sentinel():
+    """Zero evidence must not become a confident class-0 prediction."""
+    labels = jnp.asarray([[3, 1], [2, 2]], dtype=jnp.int32)
+    valid = jnp.asarray([[False, False], [True, True]])
+    for tb in ("nearest", "lowest"):
+        r = vote(labels, valid, 5, tie_break=tb)
+        assert int(r.predictions[0]) == -1
+        assert int(r.predictions[1]) == 2
+
+
 def test_classify_from_labels_gathers_and_masks():
     ids = jnp.asarray([[2, 0, -1]], dtype=jnp.int32)
     labels = jnp.asarray([4, 1, 4], dtype=jnp.int32)
