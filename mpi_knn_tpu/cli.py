@@ -35,8 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--data",
         default="mnist",
         help="'mnist' (real if found, else synthetic), 'synthetic:MxDcC' "
-        "(e.g. synthetic:4096x128c10), or a .mat file with "
-        "train_X/train_labels in the reference layout",
+        "(e.g. synthetic:4096x128c10), 'sift:M' (SIFT1M-shaped surrogate, "
+        "e.g. sift:1000000), or a .mat file with train_X/train_labels in "
+        "the reference layout",
     )
     d.add_argument("--limit", type=int, default=None, help="use first N rows only")
     d.add_argument("--svd", type=int, default=None, metavar="DIM",
@@ -51,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--tie-break", choices=TIE_BREAKS, default="nearest")
     k.add_argument("--devices", type=int, default=None,
                    help="ring size for distributed backends (default: all)")
+    k.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host: coordinator address (or set "
+                   "JAX_COORDINATOR_ADDRESS); launch one process per host")
+    k.add_argument("--num-processes", type=int, default=None,
+                   help="multi-host: total process count (JAX_NUM_PROCESSES)")
+    k.add_argument("--process-id", type=int, default=None,
+                   help="multi-host: this process's id (JAX_PROCESS_ID)")
     k.add_argument("--query-tile", type=int, default=1024)
     k.add_argument("--corpus-tile", type=int, default=2048)
     k.add_argument("--dtype", default="float32",
@@ -79,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("--save-every", type=int, default=8,
                    help="corpus tiles per checkpoint round")
     o.add_argument("-q", "--quiet", action="store_true")
+    o.add_argument("--recall-vs-serial", action="store_true",
+                   help="also run the serial backend and report recall@k of "
+                   "the selected backend against it (the acceptance gate, "
+                   "BASELINE.md)")
     o.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto",
                    help="force a JAX platform (some TPU plugins ignore the "
                    "JAX_PLATFORMS env var; this uses the config knob)")
@@ -95,6 +107,11 @@ def _load_data(args):
         rows, dim, classes = int(m[1]), int(m[2]), int(m[3] or 10)
         X, y = make_blobs(rows, dim, num_classes=classes, seed=0)
         return X, y, spec
+    m = re.fullmatch(r"sift:(\d+)", spec)
+    if m:
+        from mpi_knn_tpu.data.synthetic import make_sift_like
+
+        return make_sift_like(m=int(m[1])), None, spec
     if spec == "mnist":
         from mpi_knn_tpu.data.mnist import load_mnist
 
@@ -106,8 +123,8 @@ def _load_data(args):
         X, y = load_corpus_mat(spec, limit=args.limit)
     except FileNotFoundError:
         raise SystemExit(
-            f"error: --data {spec!r} is not a file, 'mnist', or a "
-            "synthetic:MxDcC spec"
+            f"error: --data {spec!r} is not a file, 'mnist', a "
+            "synthetic:MxDcC spec, or a sift:M spec"
         )
     except ValueError as e:
         raise SystemExit(f"error: {e}")
@@ -133,6 +150,21 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    import os
+
+    if args.coordinator or args.num_processes or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ):
+        from mpi_knn_tpu.parallel.distributed import init_multihost
+
+        dist_info = init_multihost(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    else:
+        dist_info = None
 
     from mpi_knn_tpu.api import all_knn, knn_classify, resolve_backend
     from mpi_knn_tpu.utils.report import RunReport
@@ -180,6 +212,8 @@ def main(argv=None) -> int:
         backend=resolve_backend(cfg),
         num_devices=cfg.num_devices or 1,
     )
+    if dist_info is not None:
+        report.notes["distributed"] = dist_info
 
     with profile_trace(args.profile):
         with timer.phase("knn"):
@@ -221,6 +255,26 @@ def main(argv=None) -> int:
                 preds = np.asarray(cls.predictions)
                 report.notes["predictions"] = preds.tolist()
 
+    if args.recall_vs_serial:
+        if report.backend == "serial":
+            # comparing serial against itself is vacuous; make that visible
+            report.recall_vs_baseline = 1.0
+            if not args.quiet:
+                print("recall-vs-serial: selected backend IS serial "
+                      "(trivially 1.0); pick --backend ring/ring-overlap/"
+                      "pallas to compare")
+        else:
+            from mpi_knn_tpu.utils.report import recall_at_k
+
+            with timer.phase("recall_baseline"):
+                base = all_knn(
+                    X, queries=queries, config=cfg.replace(backend="serial")
+                )
+                timer.block_on(base.dists)
+            report.recall_vs_baseline = recall_at_k(
+                np.asarray(result.ids), np.asarray(base.ids)
+            )
+
     report.phase_seconds = dict(timer.seconds)
 
     if not args.quiet:
@@ -236,6 +290,11 @@ def main(argv=None) -> int:
             f"[mpi_knn_tpu] backend={report.backend} shape={report.shape} "
             f"k={args.k} metric={args.metric} "
             + (f"accuracy={report.accuracy:.4f} " if report.accuracy else "")
+            + (
+                f"recall-vs-serial={report.recall_vs_baseline:.4f} "
+                if report.recall_vs_baseline is not None
+                else ""
+            )
             + f"knn={timer.seconds['knn']:.3f}s"
         )
         if args.one_based_ids:

@@ -1,0 +1,83 @@
+"""Multi-host initialization (SURVEY.md §6 "Distributed communication
+backend", §2a "Multi-host (DCN)").
+
+The reference's process model is `mpirun -np P` over a single
+``MPI_COMM_WORLD`` (``/root/reference/mpi-knn-parallel_blocking.c:58-61``):
+the launcher wires the processes, and any rank failure aborts the job. The
+TPU-native equivalent is ``jax.distributed.initialize`` — every host runs the
+same SPMD program, the runtime wires the pod, and the ring mesh is built over
+``jax.devices()`` (all hosts' devices) in physical order, so ppermute steps
+stay on ICI within a slice and cross DCN only at slice boundaries.
+
+Failure semantics (SURVEY.md §6 "Failure detection"): initialization failures
+surface as a timeout here with a clear message, rather than the reference's
+hang-at-barrier; mid-run host loss aborts the job (the checkpoint/resume
+layer in utils.checkpoint provides restart).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("mpi_knn_tpu")
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    timeout_seconds: int = 300,
+) -> dict:
+    """Join (or skip, when single-host) the multi-host runtime.
+
+    With no arguments, reads ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` (this module resolves them —
+    JAX itself only auto-detects inside recognized cluster environments like
+    Cloud TPU metadata) and no-ops when none are present — single-host runs
+    need no ceremony, unlike `mpirun`.
+
+    Returns a summary dict {process_id, num_processes, devices,
+    local_devices} for the run report.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    want_init = coordinator_address is not None or (
+        num_processes is not None and num_processes > 1
+    )
+    if want_init:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=timeout_seconds,
+            )
+        except Exception as e:  # surface, don't hang (reference hangs at barrier)
+            raise RuntimeError(
+                f"multi-host init failed (coordinator={coordinator_address}, "
+                f"processes={num_processes}, id={process_id}): {e}"
+            ) from e
+
+    info = {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+    log.info(
+        "distributed: process %d/%d, %d global devices (%d local)",
+        info["process_id"],
+        info["num_processes"],
+        info["devices"],
+        info["local_devices"],
+    )
+    return info

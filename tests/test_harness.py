@@ -197,6 +197,41 @@ def test_cli_ring_backend(capsys):
     assert "backend=ring-overlap" in capsys.readouterr().out
 
 
+def test_cli_recall_vs_serial(capsys):
+    rc = cli_main(
+        ["--data", "synthetic:96x8c4", "--k", "4", "--num-classes", "4",
+         "--backend", "ring-overlap", "--recall-vs-serial"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "recall-vs-serial=1.0000" in out
+
+
+def test_cli_sift_spec(capsys):
+    rc = cli_main(
+        ["--data", "sift:512", "--k", "3", "--backend", "serial",
+         "--query-tile", "128", "--corpus-tile", "128", "-q"]
+    )
+    assert rc == 0
+
+
+def test_multihost_init_single_host_noop():
+    from mpi_knn_tpu.parallel.distributed import init_multihost
+
+    info = init_multihost()
+    assert info["num_processes"] == 1
+    assert info["devices"] == 8  # the virtual CPU mesh
+
+
+def test_sift_generator_chunked_deterministic():
+    from mpi_knn_tpu.data.synthetic import make_sift_like
+
+    a = make_sift_like(m=300, d=16, chunk=128)
+    b = make_sift_like(m=300, d=16, chunk=128)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (300, 16) and a.min() >= 0 and a.max() <= 255
+
+
 def test_cli_entrypoint_subprocess():
     """python -m mpi_knn_tpu works as a real process (CPU via --platform)."""
     r = subprocess.run(
