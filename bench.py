@@ -4,16 +4,31 @@
 Prints ONE JSON line:
     {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
 
-- value: best wall-clock seconds of the all-kNN phase (post-compile,
-  device-synchronized) on the available hardware.
-- vs_baseline: north_star_seconds / value, scaled by the fraction of the
-  8-chip target this host provides (1 chip => target is 8 s), so >1.0 beats
-  the north star at equal silicon. Recall@10 against the f64 oracle on a
-  subsample is checked and reported in the JSON; a recall miss zeroes
-  vs_baseline rather than reporting a fast-but-wrong number.
+Methodology (mirrors the reference, which times ONLY the distance/top-k
+phase — ``/root/reference/knn-serial.c:70,94-98`` — not I/O or voting):
+
+- the corpus is placed on device once, outside the timed region;
+- each timed rep runs the full ``all_knn`` API path on the device-resident
+  corpus and synchronizes with ``device_sync`` (a 1-element fetch —
+  ``block_until_ready`` alone can return at dispatch time on tunneled
+  device transports and would under-report);
+- value = best rep wall-clock of the all-kNN phase;
+- recall@10 is checked against a float64 host oracle on a 256-query sample
+  (computed in matmul form, chunk-free at this sample size); a recall miss
+  (<0.999) zeroes vs_baseline rather than reporting a fast-but-wrong number.
+
+vs_baseline: north_star_seconds / value, scaled by the fraction of the
+8-chip target this host provides (1 chip => target is 8 s), so >1.0 beats
+the north star at equal silicon.
 
 Environment knobs: BENCH_M (default 60000), BENCH_BACKEND (serial|pallas),
-BENCH_REPS, TKNN_MNIST (real data path; synthetic surrogate otherwise).
+BENCH_REPS, BENCH_QT/BENCH_CT (tiles), BENCH_TOPK (exact|approx),
+TKNN_MNIST (real data path; synthetic surrogate otherwise).
+
+The recall gate is FIXED at 0.999 regardless of knobs — it is the north
+star's acceptance bar, not a tunable. Setting BENCH_RT below it tunes
+approx_min_k to a recall the gate will reject, zeroing vs_baseline by
+design (speed bought with recall does not count).
 """
 
 import json
@@ -26,10 +41,28 @@ import numpy as np
 
 NORTH_STAR_SECONDS = 1.0  # on 8 chips (v5e-8)
 NORTH_STAR_CHIPS = 8
+RECALL_GATE = 0.999
+
+
+def oracle_topk(X: np.ndarray, sample: np.ndarray, k: int) -> np.ndarray:
+    """f64 ground-truth neighbor ids for the sampled queries, matmul form
+    (no (q, m, d) broadcast — that would be ~100 GB at MNIST scale)."""
+    Xs = X.astype(np.float64)
+    Q = Xs[sample]
+    d = (
+        (Q**2).sum(1)[:, None]
+        + (Xs**2).sum(1)[None, :]
+        - 2.0 * (Q @ Xs.T)
+    )
+    # reference zero-exclusion (SURVEY.md Q3) + exact self-exclusion
+    d[d <= 1e-9] = np.inf
+    d[np.arange(len(sample)), sample] = np.inf
+    return np.argsort(d, axis=1, kind="stable")[:, :k]
 
 
 def main() -> int:
     import jax
+    import jax.numpy as jnp
 
     m = int(os.environ.get("BENCH_M", "60000"))
     k = int(os.environ.get("BENCH_K", "10"))
@@ -39,42 +72,47 @@ def main() -> int:
     from mpi_knn_tpu import KNNConfig, all_knn
     from mpi_knn_tpu.data.mnist import load_mnist
     from mpi_knn_tpu.utils.report import recall_at_k
+    from mpi_knn_tpu.utils.timing import device_sync
 
     X, _, source = load_mnist(m=m)
     cfg = KNNConfig(
         k=k,
         backend=backend,
-        query_tile=int(os.environ.get("BENCH_QT", "2048")),
-        corpus_tile=int(os.environ.get("BENCH_CT", "4096")),
+        query_tile=int(os.environ.get("BENCH_QT", "4096")),
+        # whole corpus per query tile: one matmul + one top-k per tile beats
+        # many small merge steps (measured on v5e)
+        corpus_tile=int(os.environ.get("BENCH_CT", str(1 << 20))),
+        topk_method=os.environ.get("BENCH_TOPK", "exact"),
+        recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
         matmul_precision=os.environ.get("BENCH_PRECISION") or None,
     )
 
+    # data to device ONCE — the timed region is the all-kNN phase, matching
+    # the reference's timer placement
+    Xd = jax.device_put(jnp.asarray(X, dtype=jnp.dtype(cfg.dtype)))
+    device_sync(Xd)
+
     # compile + warm up
-    result = all_knn(X, config=cfg)
-    result.dists.block_until_ready()
+    result = all_knn(Xd, config=cfg)
+    device_sync(result.dists)
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        result = all_knn(X, config=cfg)
-        result.dists.block_until_ready()
+        result = all_knn(Xd, config=cfg)
+        device_sync(result.dists, result.ids)
         times.append(time.perf_counter() - t0)
     value = min(times)
 
-    # recall vs the f64 oracle on a query subsample (full oracle is O(m^2) on
-    # host; 256 rows give a tight estimate)
     sample = np.linspace(0, m - 1, num=min(256, m), dtype=np.int64)
-    Xs = X.astype(np.float64)
-    d = ((Xs[sample][:, None, :] - Xs[None, :, :]) ** 2).sum(-1)
-    d[d <= 0.0] = np.inf
-    d[np.arange(len(sample)), sample] = np.inf
-    want = np.argsort(d, axis=1, kind="stable")[:, :k]
-    recall = recall_at_k(np.asarray(result.ids)[sample], want)
+    want = oracle_topk(X, sample, k)
+    got = np.asarray(jax.device_get(result.ids[jnp.asarray(sample)]))
+    recall = recall_at_k(got, want)
 
     n_chips = jax.local_device_count() if jax.default_backend() == "tpu" else 1
     target_here = NORTH_STAR_SECONDS * (NORTH_STAR_CHIPS / n_chips)
-    vs = (target_here / value) if recall >= 0.999 else 0.0
+    vs = (target_here / value) if recall >= RECALL_GATE else 0.0
 
     line = {
         "metric": f"mnist{m // 1000}k_allknn_k{k}_seconds",
@@ -95,6 +133,9 @@ def main() -> int:
                 "chips": n_chips,
                 "platform": jax.default_backend(),
                 "target_seconds_at_this_chip_count": target_here,
+                "recall_gate": RECALL_GATE,
+                "topk_method": cfg.topk_method,
+                "tiles": [cfg.query_tile, cfg.corpus_tile],
             }
         ),
         file=sys.stderr,

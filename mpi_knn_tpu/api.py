@@ -46,22 +46,33 @@ def all_knn(
       global ids.
     """
     cfg = (config or KNNConfig()).replace(**overrides)
-    corpus = np.asarray(corpus)
+    on_device = isinstance(corpus, jax.Array)
+    if not on_device:
+        corpus = np.asarray(corpus)
     m = corpus.shape[0]
 
     if queries is None:
         q_arr = corpus
         q_ids = np.arange(m, dtype=np.int32)
     else:
-        q_arr = np.asarray(queries)
+        q_arr = queries if isinstance(queries, jax.Array) else np.asarray(queries)
         # no query has a corpus identity in query mode; -1 never matches a
         # *valid* candidate id, so self-exclusion is a no-op
         q_ids = np.full(q_arr.shape[0], -1, dtype=np.int32)
 
     if cfg.center and cfg.metric == "l2":
         # translation leaves L2 distances unchanged but conditions the
-        # ‖x‖²+‖y‖²−2xy form: cancellation error tracks the centered norms
-        mu = corpus.astype(np.float64).mean(axis=0)
+        # ‖x‖²+‖y‖²−2xy form: cancellation error tracks the centered norms.
+        # Device-resident inputs are centered on device; the mean accumulates
+        # in the corpus dtype's own precision class (f64 stays f64 for the
+        # debug mode when x64 is enabled; f32/bf16 accumulate in f32).
+        if on_device:
+            import jax.numpy as jnp
+
+            acc = jnp.float64 if corpus.dtype == jnp.float64 else jnp.float32
+            mu = jnp.mean(corpus, axis=0, dtype=acc)
+        else:
+            mu = corpus.astype(np.float64).mean(axis=0)
         corpus = corpus - mu
         q_arr = q_arr - mu if queries is not None else corpus
 

@@ -18,7 +18,7 @@ from mpi_knn_tpu.ops.pallas_knn import fused_knn_tiles
 from mpi_knn_tpu.ops.topk import smallest_k
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
-    pad_rows,
+    pad_rows_any,
     pad_to_multiple,
 )
 
@@ -78,8 +78,8 @@ def all_knn_pallas(
     c_pad = pad_to_multiple(m, c_tile)
     q_pad = pad_to_multiple(nq, q_tile)
 
-    corpus_p = jnp.asarray(pad_rows(np.asarray(corpus), c_pad), dtype=jnp.float32)
-    queries_p = jnp.asarray(pad_rows(np.asarray(queries), q_pad), dtype=jnp.float32)
+    corpus_p = pad_rows_any(corpus, c_pad, dtype=jnp.float32)
+    queries_p = pad_rows_any(queries, q_pad, dtype=jnp.float32)
 
     best_d, best_i = _pallas_all_knn(
         queries_p, corpus_p, cfg, q_tile, c_tile, m, all_pairs

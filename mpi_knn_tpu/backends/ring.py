@@ -49,7 +49,7 @@ from mpi_knn_tpu.backends.serial import knn_tile_step
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
-    pad_rows,
+    pad_rows_any,
     pad_to_multiple,
 )
 
@@ -203,12 +203,10 @@ def all_knn_ring(
     c_pad = pad_to_multiple(m, num_dev * c_tile)
     q_pad = pad_to_multiple(nq, num_dev * q_tile)
 
-    corpus_p = jnp.asarray(pad_rows(np.asarray(corpus), c_pad), dtype=dtype)
+    corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
     corpus_ids = jnp.asarray(make_global_ids(m, c_pad))
-    queries_p = jnp.asarray(pad_rows(np.asarray(queries), q_pad), dtype=dtype)
-    qids_p = jnp.asarray(
-        pad_rows(np.asarray(query_ids, dtype=np.int32), q_pad, fill=-1)
-    )
+    queries_p = pad_rows_any(queries, q_pad, dtype=dtype)
+    qids_p = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32)
 
     sharding = NamedSharding(mesh, P(axis))
     corpus_p = jax.device_put(corpus_p, sharding)

@@ -29,7 +29,7 @@ from mpi_knn_tpu.ops.distance import pairwise_dist, sq_norms
 from mpi_knn_tpu.ops.topk import init_topk, mask_tile, smallest_k
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
-    pad_rows,
+    pad_rows_any,
     pad_to_multiple,
 )
 
@@ -127,7 +127,9 @@ def effective_tiles(cfg: KNNConfig, m: int, nq: int) -> tuple[int, int]:
 
 
 def prepare_tiles(corpus, queries, query_ids, cfg: KNNConfig, q_tile, c_tile):
-    """Pad + reshape host arrays into device tile stacks."""
+    """Pad + reshape corpus/query arrays into device tile stacks. Host numpy
+    inputs are padded on host then transferred once; device inputs are padded
+    with on-device ops (no device→host round trip)."""
     m, dim = corpus.shape
     nq = queries.shape[0]
     dtype = jnp.dtype(cfg.dtype)
@@ -135,17 +137,11 @@ def prepare_tiles(corpus, queries, query_ids, cfg: KNNConfig, q_tile, c_tile):
     c_pad = pad_to_multiple(m, c_tile)
     q_pad = pad_to_multiple(nq, q_tile)
 
-    corpus_tiles = jnp.asarray(
-        pad_rows(np.asarray(corpus), c_pad).reshape(-1, c_tile, dim), dtype=dtype
-    )
+    corpus_tiles = pad_rows_any(corpus, c_pad, dtype=dtype).reshape(-1, c_tile, dim)
     corpus_tile_ids = jnp.asarray(make_global_ids(m, c_pad).reshape(-1, c_tile))
-    q_tiles = jnp.asarray(
-        pad_rows(np.asarray(queries), q_pad).reshape(-1, q_tile, dim), dtype=dtype
-    )
-    qid_tiles = jnp.asarray(
-        pad_rows(np.asarray(query_ids, dtype=np.int32), q_pad, fill=-1).reshape(
-            -1, q_tile
-        )
+    q_tiles = pad_rows_any(queries, q_pad, dtype=dtype).reshape(-1, q_tile, dim)
+    qid_tiles = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32).reshape(
+        -1, q_tile
     )
     return q_tiles, qid_tiles, corpus_tiles, corpus_tile_ids, q_pad
 

@@ -143,6 +143,20 @@ def _load_queries(path):
     raise SystemExit(f"{path}: no queries/train_X variable")
 
 
+def _to_host(a) -> np.ndarray:
+    """Fetch a result array to host numpy. Multi-host runs produce globally
+    sharded arrays that are not fully addressable from one process —
+    np.asarray would raise — so those are allgathered first (every process
+    gets the full array, mirroring the reference's per-rank stdout model)."""
+    import jax
+
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        a = multihost_utils.process_allgather(a, tiled=True)
+    return np.asarray(a)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -153,8 +167,21 @@ def main(argv=None) -> int:
 
     import os
 
-    if args.coordinator or args.num_processes or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
+    if args.process_id is not None and not (
+        args.coordinator or args.num_processes
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_NUM_PROCESSES")
+    ):
+        raise SystemExit(
+            "error: --process-id requires --coordinator/--num-processes "
+            "(or the JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES env vars); "
+            "refusing to silently run single-host"
+        )
+    if (
+        args.coordinator
+        or args.num_processes
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_NUM_PROCESSES")
     ):
         from mpi_knn_tpu.parallel.distributed import init_multihost
 
@@ -247,32 +274,43 @@ def main(argv=None) -> int:
                 )
                 timer.block_on(cls.predictions)
             if queries is None:
-                report.matches = int(cls.matches(labels))
+                preds = _to_host(cls.predictions)
+                report.matches = int((preds == np.asarray(labels)[: len(preds)]).sum())
                 report.total = int(len(labels))
                 report.accuracy = report.matches / report.total
             else:
                 # query mode: the predictions ARE the output
-                preds = np.asarray(cls.predictions)
+                preds = _to_host(cls.predictions)
                 report.notes["predictions"] = preds.tolist()
 
     if args.recall_vs_serial:
-        if report.backend == "serial":
-            # comparing serial against itself is vacuous; make that visible
+        if report.backend == "serial" or args.checkpoint_dir:
+            # comparing serial math against itself is vacuous (the
+            # checkpoint/resume driver always runs the serial path); make
+            # that visible instead of reporting a hollow 1.0 for a backend
+            # that never ran
             report.recall_vs_baseline = 1.0
             if not args.quiet:
-                print("recall-vs-serial: selected backend IS serial "
-                      "(trivially 1.0); pick --backend ring/ring-overlap/"
-                      "pallas to compare")
+                why = ("resumable runs serial math"
+                       if args.checkpoint_dir else "selected backend IS serial")
+                print(f"recall-vs-serial: {why} (trivially 1.0); pick "
+                      "--backend ring/ring-overlap/pallas without "
+                      "--checkpoint-dir to compare")
         else:
             from mpi_knn_tpu.utils.report import recall_at_k
 
             with timer.phase("recall_baseline"):
+                # the baseline must be EXACT serial ground truth — inheriting
+                # an approx topk_method would let shared approximation error
+                # cancel and overstate recall
                 base = all_knn(
-                    X, queries=queries, config=cfg.replace(backend="serial")
+                    X,
+                    queries=queries,
+                    config=cfg.replace(backend="serial", topk_method="exact"),
                 )
                 timer.block_on(base.dists)
             report.recall_vs_baseline = recall_at_k(
-                np.asarray(result.ids), np.asarray(base.ids)
+                _to_host(result.ids), _to_host(base.ids)
             )
 
     report.phase_seconds = dict(timer.seconds)
@@ -283,13 +321,12 @@ def main(argv=None) -> int:
         if report.matches is not None:
             print(f"Matches: {report.matches}")
         if cls is not None and queries is not None:
-            preds = np.asarray(cls.predictions)
             print(f"predictions ({len(preds)} queries): {preds[:20].tolist()}"
                   + (" ..." if len(preds) > 20 else ""))
         print(
             f"[mpi_knn_tpu] backend={report.backend} shape={report.shape} "
             f"k={args.k} metric={args.metric} "
-            + (f"accuracy={report.accuracy:.4f} " if report.accuracy else "")
+            + (f"accuracy={report.accuracy:.4f} " if report.accuracy is not None else "")
             + (
                 f"recall-vs-serial={report.recall_vs_baseline:.4f} "
                 if report.recall_vs_baseline is not None
@@ -298,7 +335,7 @@ def main(argv=None) -> int:
             + f"knn={timer.seconds['knn']:.3f}s"
         )
         if args.one_based_ids:
-            ids = np.asarray(result.one_based())
+            ids = _to_host(result.one_based())
             print("neighbor ids (1-based, first 5 queries):")
             print(ids[:5])
 

@@ -36,7 +36,7 @@ def make_sift_like(m: int = 1_000_000, d: int = 128, seed: int = 0,
         hi = min(lo + chunk, m)
         which = rng.integers(0, centers.shape[0], size=hi - lo)
         block = centers[which] + rng.standard_normal((hi - lo, d)) * 30.0
-        out[lo:hi] = np.clip(block, 0.0, 255.0).astype(np.float32)
+        out[lo:hi] = np.clip(np.rint(block), 0.0, 255.0).astype(np.float32)
     return out
 
 

@@ -12,6 +12,8 @@ to +inf distance (SURVEY.md §8 "Divisibility/padding").
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mpi_knn_tpu.types import INVALID_ID
@@ -33,6 +35,22 @@ def pad_rows(x: np.ndarray, target_rows: int, fill=0.0) -> np.ndarray:
         return x
     pad_width = [(0, target_rows - m)] + [(0, 0)] * (x.ndim - 1)
     return np.pad(x, pad_width, constant_values=fill)
+
+
+def pad_rows_any(x, target_rows: int, fill=0.0, dtype=None) -> jax.Array:
+    """``pad_rows`` that returns a device array and never bounces a
+    device-resident input through the host: jax.Array inputs are padded with
+    on-device ops, everything else is padded in numpy then transferred once."""
+    if isinstance(x, jax.Array):
+        out = x if dtype is None else x.astype(dtype)
+        extra = target_rows - x.shape[0]
+        if extra < 0:
+            raise ValueError(f"target_rows {target_rows} < rows {x.shape[0]}")
+        if extra:
+            widths = [(0, extra)] + [(0, 0)] * (x.ndim - 1)
+            out = jnp.pad(out, widths, constant_values=fill)
+        return out
+    return jnp.asarray(pad_rows(np.asarray(x), target_rows, fill=fill), dtype=dtype)
 
 
 def make_global_ids(m: int, padded: int) -> np.ndarray:
