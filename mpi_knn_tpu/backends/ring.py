@@ -45,7 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import sq_norms
 from mpi_knn_tpu.ops.topk import init_topk
-from mpi_knn_tpu.backends.serial import knn_tile_step
+from mpi_knn_tpu.backends.serial import cap_corpus_tile, knn_tile_step
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
@@ -200,6 +200,9 @@ def all_knn_ring(
     # small problems so padding never exceeds P·tile rows.
     c_tile = min(cfg.corpus_tile, -(-m // num_dev))
     q_tile = min(cfg.query_tile, -(-nq // num_dev))
+    # same per-tile memory policy as the serial backend: the (q_tile × c_tile)
+    # distance block each device materializes is capped by cfg.max_tile_elems
+    c_tile = cap_corpus_tile(q_tile, c_tile, cfg.max_tile_elems)
     c_pad = pad_to_multiple(m, num_dev * c_tile)
     q_pad = pad_to_multiple(nq, num_dev * q_tile)
 

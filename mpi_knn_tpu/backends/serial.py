@@ -118,12 +118,26 @@ def knn_chunk_update(
     return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, carry_d, carry_i))
 
 
+def cap_corpus_tile(q_tile: int, c_tile: int, max_tile_elems: int) -> int:
+    """Shrink c_tile until q_tile × c_tile <= max_tile_elems — the hard
+    bound on the per-step distance block a backend may materialize. The cap
+    is rounded down to a 128 multiple while that keeps it >= 128 (MXU lane
+    alignment); rounding down only ever shrinks, so the bound stays hard.
+    Shared by the serial and ring backends so the memory plan is one policy."""
+    cap = max(1, max_tile_elems // max(q_tile, 1))
+    if cap >= 128:
+        cap = cap // 128 * 128
+    return min(c_tile, cap)
+
+
 def effective_tiles(cfg: KNNConfig, m: int, nq: int) -> tuple[int, int]:
     """Clamp configured tiles to the (aligned) problem size so small inputs
-    don't pay full-tile padding compute."""
+    don't pay full-tile padding compute, and to ``cfg.max_tile_elems`` so a
+    "whole corpus per tile" request can't materialize an HBM-busting
+    (q_tile × c_tile) distance block at SIFT1M scale."""
     q_tile = min(cfg.query_tile, pad_to_multiple(nq, 8))
     c_tile = min(cfg.corpus_tile, pad_to_multiple(m, 128))
-    return q_tile, c_tile
+    return q_tile, cap_corpus_tile(q_tile, c_tile, cfg.max_tile_elems)
 
 
 def prepare_tiles(corpus, queries, query_ids, cfg: KNNConfig, q_tile, c_tile):

@@ -78,6 +78,14 @@ class KNNConfig:
     num_classes: int = 10
     mesh_axis: str = "ring"
     num_devices: Optional[int] = None
+    # hard cap on query_tile × corpus_tile elements of one distance tile —
+    # the HBM-resident intermediate a backend may materialize. 2^28 f32
+    # elements = 1 GiB, safely inside a 16 GiB chip alongside the corpus.
+    # Oversized configs are clamped by shrinking corpus_tile (see
+    # backends.serial.cap_corpus_tile, shared with the ring backend), which
+    # is what makes "corpus_tile = whole corpus" requests safe at SIFT1M
+    # scale. query_tile is never clamped by this cap — keep it modest.
+    max_tile_elems: int = 1 << 28
 
     def __post_init__(self):
         if self.backend not in BACKENDS:
