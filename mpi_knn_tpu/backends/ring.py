@@ -64,6 +64,7 @@ def _ring_knn_local(
     axis: str,
     q_tile: int,  # divides q_local
     c_tile: int,  # divides b
+    vary_axes: tuple = (),  # all manual axes (for marking the carry varying)
 ):
     """Per-device body under shard_map: rotate corpus blocks around the ring,
     merging each into the local top-k carry.
@@ -88,9 +89,12 @@ def _ring_knn_local(
     carry_d = carry_d.reshape(q_local // q_tile, q_tile, cfg.k)
     carry_i = carry_i.reshape(q_local // q_tile, q_tile, cfg.k)
     # the carry starts replicated but each device's top-k diverges; mark it
-    # device-varying over the ring axis so the scan carry type is stable
-    carry_d = jax.lax.pcast(carry_d, (axis,), to="varying")
-    carry_i = jax.lax.pcast(carry_i, (axis,), to="varying")
+    # device-varying over every manual mesh axis (ring always; dp too on a
+    # 2-D mesh, where per-device queries differ) so the scan carry type is
+    # stable from step 0
+    vary = tuple(vary_axes) or (axis,)
+    carry_d = jax.lax.pcast(carry_d, vary, to="varying")
+    carry_i = jax.lax.pcast(carry_i, vary, to="varying")
 
     def compute(blk, blk_ids, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
@@ -149,13 +153,38 @@ def _ring_knn_local(
     return carry_d.reshape(q_local, cfg.k), carry_i.reshape(q_local, cfg.k)
 
 
+def _query_spec(q_axis, axis):
+    """Single source of truth for the query PartitionSpec: queries shard over
+    EVERY mesh axis (each device owns a distinct query slice — total work
+    nq·m splits over all devices) while the corpus shards over the ring axis
+    only. The host-side device_put and the shard_map in_specs must agree or
+    XLA silently reshards the padded query array before every run."""
+    return P((q_axis, axis)) if q_axis else P(axis)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "overlap", "mesh", "axis", "q_tile", "c_tile"),
+    static_argnames=(
+        "cfg", "overlap", "mesh", "axis", "q_tile", "c_tile", "q_axis"
+    ),
 )
 def _ring_knn_sharded(
-    queries, query_ids, corpus, corpus_ids, cfg, overlap, mesh, axis, q_tile, c_tile
+    queries,
+    query_ids,
+    corpus,
+    corpus_ids,
+    cfg,
+    overlap,
+    mesh,
+    axis,
+    q_tile,
+    c_tile,
+    q_axis=None,
 ):
+    """Shard-mapped ring. On a 1-D mesh queries and corpus share the ring
+    axis (the reference's layout). On a 2-D (dp × ring) mesh queries shard
+    over `q_axis` (data parallel) while the corpus rings over `axis` — each
+    dp group runs an independent ring over its replica of the corpus."""
     body = functools.partial(
         _ring_knn_local,
         cfg=cfg,
@@ -163,13 +192,15 @@ def _ring_knn_sharded(
         axis=axis,
         q_tile=q_tile,
         c_tile=c_tile,
+        vary_axes=tuple(mesh.axis_names),
     )
-    spec = P(axis)
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec),
-        out_specs=(spec, spec),
+        in_specs=(qspec, qspec, cspec, cspec),
+        out_specs=(qspec, qspec),
     )
     return fn(queries, query_ids, corpus, corpus_ids)
 
@@ -187,8 +218,19 @@ def all_knn_ring(
     smuggling, SURVEY.md C6), run the sharded ring, strip padding."""
     if mesh is None:
         mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
-    axis = mesh.axis_names[0]
-    num_dev = mesh.devices.size
+    if len(mesh.axis_names) == 2:
+        # 2-D (dp × ring): queries shard over the major axis, corpus rings
+        # over the minor axis (adjacent ICI links within each dp group)
+        q_axis, axis = mesh.axis_names
+        dp, ring_n = mesh.devices.shape
+    elif len(mesh.axis_names) == 1:
+        q_axis, axis = None, mesh.axis_names[0]
+        dp, ring_n = 1, mesh.devices.size
+    else:
+        raise ValueError(
+            f"mesh must be 1-D (ring) or 2-D (dp × ring), got axes "
+            f"{mesh.axis_names}"
+        )
 
     m, dim = corpus.shape
     nq = queries.shape[0]
@@ -198,12 +240,13 @@ def all_knn_ring(
     # into on-device tiles (the reference silently required P | m,
     # SURVEY.md Q6 — we pad + mask). Tiles shrink to the shard size for
     # small problems so padding never exceeds P·tile rows.
-    c_tile = min(cfg.corpus_tile, -(-m // num_dev))
+    num_dev = dp * ring_n  # queries shard over every device
+    c_tile = min(cfg.corpus_tile, -(-m // ring_n))
     q_tile = min(cfg.query_tile, -(-nq // num_dev))
     # same per-tile memory policy as the serial backend: the (q_tile × c_tile)
     # distance block each device materializes is capped by cfg.max_tile_elems
     c_tile = cap_corpus_tile(q_tile, c_tile, cfg.max_tile_elems)
-    c_pad = pad_to_multiple(m, num_dev * c_tile)
+    c_pad = pad_to_multiple(m, ring_n * c_tile)
     q_pad = pad_to_multiple(nq, num_dev * q_tile)
 
     corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
@@ -211,11 +254,12 @@ def all_knn_ring(
     queries_p = pad_rows_any(queries, q_pad, dtype=dtype)
     qids_p = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32)
 
-    sharding = NamedSharding(mesh, P(axis))
-    corpus_p = jax.device_put(corpus_p, sharding)
-    corpus_ids = jax.device_put(corpus_ids, sharding)
-    queries_p = jax.device_put(queries_p, sharding)
-    qids_p = jax.device_put(qids_p, sharding)
+    c_sharding = NamedSharding(mesh, P(axis))
+    q_sharding = NamedSharding(mesh, _query_spec(q_axis, axis))
+    corpus_p = jax.device_put(corpus_p, c_sharding)
+    corpus_ids = jax.device_put(corpus_ids, c_sharding)
+    queries_p = jax.device_put(queries_p, q_sharding)
+    qids_p = jax.device_put(qids_p, q_sharding)
 
     best_d, best_i = _ring_knn_sharded(
         queries_p,
@@ -228,5 +272,6 @@ def all_knn_ring(
         axis,
         q_tile,
         c_tile,
+        q_axis=q_axis,
     )
     return best_d[:nq], best_i[:nq]

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--tie-break", choices=TIE_BREAKS, default="nearest")
     k.add_argument("--devices", type=int, default=None,
                    help="ring size for distributed backends (default: all)")
+    k.add_argument("--dp", type=int, default=1,
+                   help="2-D mesh: data-parallel groups; devices/dp form the "
+                   "corpus ring inside each group (queries shard over all "
+                   "devices, corpus memory scales with the ring size)")
     k.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                    help="multi-host: coordinator address (or set "
                    "JAX_COORDINATOR_ADDRESS); launch one process per host")
@@ -232,6 +236,30 @@ def main(argv=None) -> int:
                 # project queries into the same principal subspace
                 queries = (queries - np.asarray(mu)) @ np.asarray(comps)
 
+    mesh = None
+    if args.dp and args.dp > 1:
+        import jax
+
+        from mpi_knn_tpu.parallel.mesh import make_mesh2d
+
+        if args.backend not in ("ring", "ring-overlap", "auto"):
+            raise SystemExit(
+                f"error: --dp requires a ring backend (got --backend "
+                f"{args.backend}; serial/pallas ignore the mesh)"
+            )
+        if args.checkpoint_dir:
+            raise SystemExit(
+                "error: --dp cannot be combined with --checkpoint-dir "
+                "(the resumable driver runs the serial path, which ignores "
+                "the mesh)"
+            )
+        total = args.devices or len(jax.devices())
+        if total % args.dp:
+            raise SystemExit(
+                f"error: --dp {args.dp} must divide the device count {total}"
+            )
+        mesh = make_mesh2d(args.dp, total // args.dp)
+
     report = RunReport(
         config=vars(args),
         data_source=source,
@@ -261,7 +289,7 @@ def main(argv=None) -> int:
                 )
                 result = KNNResult(dists=d, ids=i)
             else:
-                result = all_knn(X, queries=queries, config=cfg)
+                result = all_knn(X, queries=queries, config=cfg, mesh=mesh)
             timer.block_on(result.dists)
 
         do_vote = labels is not None and (args.loo or queries is None)

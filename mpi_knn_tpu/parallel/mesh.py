@@ -35,3 +35,28 @@ def make_ring_mesh(
             )
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_mesh2d(
+    dp: int,
+    ring: int,
+    dp_axis: str = "dp",
+    ring_axis: str = "ring",
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """2-D (dp × ring) mesh: queries shard over `dp`, the corpus rings over
+    `ring`, so query throughput and corpus capacity scale independently —
+    the strategy mix the reference cannot express (its one MPI axis carries
+    both partitions in lockstep, SURVEY.md §2a).
+
+    The ring axis is the minor (fastest-varying) axis so each dp group's
+    ppermute steps ride adjacent ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * ring
+    if need > len(devices):
+        raise ValueError(
+            f"requested {dp}×{ring}={need} devices, only {len(devices)} visible"
+        )
+    grid = np.asarray(devices[:need]).reshape(dp, ring)
+    return Mesh(grid, (dp_axis, ring_axis))
