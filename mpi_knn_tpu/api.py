@@ -61,20 +61,9 @@ def all_knn(
         q_ids = np.full(q_arr.shape[0], -1, dtype=np.int32)
 
     if cfg.center and cfg.metric == "l2":
-        # translation leaves L2 distances unchanged but conditions the
-        # ‖x‖²+‖y‖²−2xy form: cancellation error tracks the centered norms.
-        # Device-resident inputs are centered on device; the mean accumulates
-        # in the corpus dtype's own precision class (f64 stays f64 for the
-        # debug mode when x64 is enabled; f32/bf16 accumulate in f32).
-        if on_device:
-            import jax.numpy as jnp
+        from mpi_knn_tpu.ops.distance import center_for_l2
 
-            acc = jnp.float64 if corpus.dtype == jnp.float64 else jnp.float32
-            mu = jnp.mean(corpus, axis=0, dtype=acc)
-        else:
-            mu = corpus.astype(np.float64).mean(axis=0)
-        corpus = corpus - mu
-        q_arr = q_arr - mu if queries is not None else corpus
+        corpus, q_arr = center_for_l2(corpus, q_arr, all_pairs=queries is None)
 
     backend = resolve_backend(cfg, mesh)
     if backend == "serial":

@@ -50,10 +50,9 @@ def all_knn_resumable(
         queries.shape == corpus.shape and np.shares_memory(queries, corpus)
     )
     if cfg.center and cfg.metric == "l2":
-        # same conditioning as api.all_knn: translation-invariant for L2
-        mu = corpus.astype(np.float64).mean(axis=0)
-        corpus = corpus - mu
-        queries = corpus if all_pairs else queries - mu
+        from mpi_knn_tpu.ops.distance import center_for_l2
+
+        corpus, queries = center_for_l2(corpus, queries, all_pairs)
 
     nq = queries.shape[0]
     q_tile, c_tile = effective_tiles(cfg, corpus.shape[0], nq)

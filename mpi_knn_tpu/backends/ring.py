@@ -65,6 +65,11 @@ def _ring_knn_local(
     q_tile: int,  # divides q_local
     c_tile: int,  # divides b
     vary_axes: tuple = (),  # all manual axes (for marking the carry varying)
+    single_round: bool = False,  # run ONE round and return the rotated block
+    carry_in=None,  # ((q_local, k) dists, ids) to continue from (resume)
+    rotate: bool = True,  # single-round only: skip the ppermute on the last
+    # round (the scan path gets this for free via dead-code elimination; a
+    # live jit output would actually pay the ICI transfer)
 ):
     """Per-device body under shard_map: rotate corpus blocks around the ring,
     merging each into the local top-k carry.
@@ -72,7 +77,11 @@ def _ring_knn_local(
     The per-device (q_local × b) problem is itself tiled — queries via
     ``lax.map`` over q_tile rows, the incoming block via ``lax.scan`` over
     c_tile rows — so device memory stays O(q_tile·c_tile + q_local·k + b·d)
-    regardless of shard size, same as the serial backend's streaming."""
+    regardless of shard size, same as the serial backend's streaming.
+
+    With ``single_round=True`` (the resumable driver,
+    backends.ring_resumable) exactly one round runs and the rotated block is
+    returned alongside the merged carry, so the host owns the round cursor."""
     num_dev = jax.lax.axis_size(axis)
     # send to the next rank, wrap at the end — the reference's ring direction
     # (rank -> rank+1, mpi-knn-parallel_blocking.c:131)
@@ -85,16 +94,20 @@ def _ring_knn_local(
     q_tiles = queries.reshape(q_local // q_tile, q_tile, dim)
     qid_tiles = query_ids.reshape(q_local // q_tile, q_tile)
 
-    carry_d, carry_i = init_topk(q_local, cfg.k, dtype=acc)
-    carry_d = carry_d.reshape(q_local // q_tile, q_tile, cfg.k)
-    carry_i = carry_i.reshape(q_local // q_tile, q_tile, cfg.k)
-    # the carry starts replicated but each device's top-k diverges; mark it
-    # device-varying over every manual mesh axis (ring always; dp too on a
-    # 2-D mesh, where per-device queries differ) so the scan carry type is
-    # stable from step 0
-    vary = tuple(vary_axes) or (axis,)
-    carry_d = jax.lax.pcast(carry_d, vary, to="varying")
-    carry_i = jax.lax.pcast(carry_i, vary, to="varying")
+    if carry_in is not None:
+        carry_d = carry_in[0].reshape(q_local // q_tile, q_tile, cfg.k)
+        carry_i = carry_in[1].reshape(q_local // q_tile, q_tile, cfg.k)
+    else:
+        carry_d, carry_i = init_topk(q_local, cfg.k, dtype=acc)
+        carry_d = carry_d.reshape(q_local // q_tile, q_tile, cfg.k)
+        carry_i = carry_i.reshape(q_local // q_tile, q_tile, cfg.k)
+        # the carry starts replicated but each device's top-k diverges; mark
+        # it device-varying over every manual mesh axis (ring always; dp too
+        # on a 2-D mesh, where per-device queries differ) so the scan carry
+        # type is stable from step 0
+        vary = tuple(vary_axes) or (axis,)
+        carry_d = jax.lax.pcast(carry_d, vary, to="varying")
+        carry_i = jax.lax.pcast(carry_i, vary, to="varying")
 
     def compute(blk, blk_ids, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
@@ -144,6 +157,21 @@ def _ring_knn_local(
             nxt_ids = jax.lax.ppermute(blk_ids, axis, perm)
         return (nxt, nxt_ids, cd, ci), None
 
+    if single_round:
+        if rotate:
+            (nxt, nxt_ids, carry_d, carry_i), _ = step(
+                (block, block_ids, carry_d, carry_i), None
+            )
+        else:
+            carry_d, carry_i = compute(block, block_ids, carry_d, carry_i)
+            nxt, nxt_ids = block, block_ids
+        return (
+            nxt,
+            nxt_ids,
+            carry_d.reshape(q_local, cfg.k),
+            carry_i.reshape(q_local, cfg.k),
+        )
+
     # P steps: own block once, then each of the P-1 received blocks — the
     # correct rotation the reference missed (SURVEY.md Q1). The final
     # permute's output is unused; XLA dead-code-eliminates it.
@@ -151,6 +179,39 @@ def _ring_knn_local(
         step, (block, block_ids, carry_d, carry_i), None, length=num_dev
     )
     return carry_d.reshape(q_local, cfg.k), carry_i.reshape(q_local, cfg.k)
+
+
+def parse_ring_mesh(mesh: Mesh):
+    """Single source of truth for mesh-axis interpretation, shared with the
+    resumable driver: returns (q_axis, ring_axis, dp, ring_n). 1-D = pure
+    ring; 2-D = (dp, ring) with the ring on the minor axis; anything else is
+    rejected (silently treating a 3-D mesh as a ring would merge each block
+    into the carry multiple times — wrong results, not an error)."""
+    if len(mesh.axis_names) == 2:
+        q_axis, axis = mesh.axis_names
+        dp, ring_n = mesh.devices.shape
+    elif len(mesh.axis_names) == 1:
+        q_axis, axis = None, mesh.axis_names[0]
+        dp, ring_n = 1, mesh.devices.size
+    else:
+        raise ValueError(
+            f"mesh must be 1-D (ring) or 2-D (dp × ring), got axes "
+            f"{mesh.axis_names}"
+        )
+    return q_axis, axis, dp, ring_n
+
+
+def ring_tiles(cfg: KNNConfig, m: int, nq: int, dp: int, ring_n: int):
+    """Per-device tile sizes and padded global sizes for a (dp × ring) run —
+    one policy for the scan-based and resumable ring drivers (divergence
+    would make a checkpointed carry's layout stop matching)."""
+    num_dev = dp * ring_n
+    c_tile = min(cfg.corpus_tile, -(-m // ring_n))
+    q_tile = min(cfg.query_tile, -(-nq // num_dev))
+    c_tile = cap_corpus_tile(q_tile, c_tile, cfg.max_tile_elems)
+    c_pad = pad_to_multiple(m, ring_n * c_tile)
+    q_pad = pad_to_multiple(nq, num_dev * q_tile)
+    return q_tile, c_tile, q_pad, c_pad
 
 
 def _query_spec(q_axis, axis):
@@ -218,19 +279,7 @@ def all_knn_ring(
     smuggling, SURVEY.md C6), run the sharded ring, strip padding."""
     if mesh is None:
         mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
-    if len(mesh.axis_names) == 2:
-        # 2-D (dp × ring): queries shard over the major axis, corpus rings
-        # over the minor axis (adjacent ICI links within each dp group)
-        q_axis, axis = mesh.axis_names
-        dp, ring_n = mesh.devices.shape
-    elif len(mesh.axis_names) == 1:
-        q_axis, axis = None, mesh.axis_names[0]
-        dp, ring_n = 1, mesh.devices.size
-    else:
-        raise ValueError(
-            f"mesh must be 1-D (ring) or 2-D (dp × ring), got axes "
-            f"{mesh.axis_names}"
-        )
+    q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
 
     m, dim = corpus.shape
     nq = queries.shape[0]
@@ -239,15 +288,9 @@ def all_knn_ring(
     # pad both corpus and query axes so each device's shard divides cleanly
     # into on-device tiles (the reference silently required P | m,
     # SURVEY.md Q6 — we pad + mask). Tiles shrink to the shard size for
-    # small problems so padding never exceeds P·tile rows.
-    num_dev = dp * ring_n  # queries shard over every device
-    c_tile = min(cfg.corpus_tile, -(-m // ring_n))
-    q_tile = min(cfg.query_tile, -(-nq // num_dev))
-    # same per-tile memory policy as the serial backend: the (q_tile × c_tile)
-    # distance block each device materializes is capped by cfg.max_tile_elems
-    c_tile = cap_corpus_tile(q_tile, c_tile, cfg.max_tile_elems)
-    c_pad = pad_to_multiple(m, ring_n * c_tile)
-    q_pad = pad_to_multiple(nq, num_dev * q_tile)
+    # small problems so padding never exceeds P·tile rows; the per-tile
+    # memory cap (cfg.max_tile_elems) is applied inside ring_tiles.
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
 
     corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
     corpus_ids = jnp.asarray(make_global_ids(m, c_pad))

@@ -1,0 +1,234 @@
+"""Resumable ring execution: the ppermute ring driven one round at a time
+from the host, with the sharded top-k carry checkpointed between rounds
+(SURVEY.md §6 "Checkpoint / resume" — "the ring carry saved every R rounds;
+resume continues rotation at round r").
+
+The reference's failure model is all-or-nothing: any rank death aborts the
+MPI job and every rank's partial neighbor lists are lost (stdout-only
+results, ``/root/reference/knn-serial.c:130``; barriers turn hangs total,
+``mpi-knn-parallel_blocking.c:111-243``). Here one jitted ring *round* is a
+pure function from (block, carry) to (next block, merged carry); the host
+loop owns the round cursor. A checkpoint is just (carry, rounds_done,
+fingerprint): the rotating block needs no saving because after r rounds
+device i holds corpus block (i − r) mod P — reconstructed on resume by
+rolling the padded corpus r blocks forward before sharding.
+
+``stop_after_rounds`` is the fault-injection hook (SURVEY.md §6 "failure
+detection / fault injection"): tests kill the run at an arbitrary round and
+assert the resumed result is bit-identical to an uninterrupted one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.backends.ring import (
+    _query_spec,
+    _ring_knn_local,
+    parse_ring_mesh,
+    ring_tiles,
+)
+from mpi_knn_tpu.ops.topk import init_topk
+from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+from mpi_knn_tpu.parallel.partition import (
+    make_global_ids,
+    pad_rows,
+    pad_rows_any,
+)
+from mpi_knn_tpu.utils.checkpoint import (
+    KNNCheckpoint,
+    fingerprint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "overlap", "mesh", "axis", "q_tile", "c_tile", "q_axis",
+        "rotate",
+    ),
+)
+def _ring_one_round(
+    queries,
+    query_ids,
+    block,
+    block_ids,
+    carry_d,
+    carry_i,
+    cfg,
+    overlap,
+    mesh,
+    axis,
+    q_tile,
+    c_tile,
+    q_axis=None,
+    rotate=True,
+):
+    """One ring round: merge the currently-held block into the carry and
+    rotate the block one hop. Same schedule semantics as the scan step in
+    backends.ring (overlap=True lets XLA put the ICI transfer under the
+    matmul; False sequences compute before the send). The host passes
+    ``rotate=False`` on the final round: in the scan path the last permute
+    is dead code XLA eliminates, but here the block is a live jit output and
+    would pay a real ICI transfer for nothing."""
+
+    def body(q, qid, blk, bids, cd, ci):
+        one = functools.partial(
+            _ring_knn_local,
+            cfg=cfg,
+            overlap=overlap,
+            axis=axis,
+            q_tile=q_tile,
+            c_tile=c_tile,
+            vary_axes=tuple(mesh.axis_names),
+            single_round=True,
+            carry_in=(cd, ci),
+            rotate=rotate,
+        )
+        return one(q, qid, blk, bids)
+
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qspec, qspec, cspec, cspec, qspec, qspec),
+        out_specs=(cspec, cspec, qspec, qspec),
+    )
+    return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
+
+
+def all_knn_ring_resumable(
+    corpus,
+    queries,
+    query_ids,
+    cfg: KNNConfig,
+    mesh: Mesh | None = None,
+    overlap: bool = True,
+    checkpoint_dir=None,
+    save_every: int = 1,
+    stop_after_rounds: int | None = None,
+    progress_cb=None,
+):
+    """Ring all-kNN with host-driven rounds and carry checkpoints.
+
+    Returns ((q, k) dists, (q, k) ids); with ``stop_after_rounds`` set it
+    returns the partial carry after that many rounds (fault injection —
+    a subsequent call with the same checkpoint_dir completes the run).
+    """
+    if mesh is None:
+        mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
+    q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
+
+    corpus = corpus if isinstance(corpus, jax.Array) else np.asarray(corpus)
+    all_pairs = queries is corpus
+    queries = queries if isinstance(queries, jax.Array) else np.asarray(queries)
+    # run identity: data + config + mesh topology (a different ring size
+    # changes block layout, so a carry from another mesh must not resume).
+    # fingerprint() samples the WHOLE array stridedly (device-side for jax
+    # arrays), so content changes anywhere in the corpus invalidate resume.
+    fp = (
+        fingerprint(corpus, queries, cfg)
+        + f":ring{ring_n}x{dp}:{int(overlap)}"
+    )
+
+    if cfg.center and cfg.metric == "l2":
+        from mpi_knn_tpu.ops.distance import center_for_l2
+
+        corpus, queries = center_for_l2(corpus, queries, all_pairs)
+
+    m, dim = corpus.shape
+    nq = queries.shape[0]
+    dtype = jnp.dtype(cfg.dtype)
+
+    # same tiling policy as the scan-based ring (shared helper — a drift
+    # here would make a saved carry's layout stop matching)
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
+
+    acc = jnp.float64 if dtype == jnp.float64 else jnp.float32
+    start_round = 0
+    carry_d, carry_i = init_topk(q_pad, cfg.k, dtype=acc)
+
+    if checkpoint_dir is not None:
+        state = load_checkpoint(checkpoint_dir, fp)
+        if state is not None:
+            start_round = state.tiles_done  # field reused as rounds_done
+            carry_d = jnp.asarray(state.carry_d, dtype=acc)
+            carry_i = jnp.asarray(state.carry_i)
+
+    # after r rounds device i holds block (i − r) mod ring_n: roll the padded
+    # corpus r blocks forward so sharding lands blocks correctly on resume.
+    # Host inputs are rolled in numpy BEFORE the transfer (no extra device
+    # copy); a device-resident corpus pays one transient on-device duplicate
+    # (jnp.roll), acceptable because such a corpus already fits one device.
+    shift = start_round * (c_pad // ring_n)
+    corpus_ids_np = make_global_ids(m, c_pad)
+    corpus_ids = jnp.asarray(np.roll(corpus_ids_np, shift) if shift else
+                             corpus_ids_np)
+    if isinstance(corpus, jax.Array):
+        corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
+        if shift:
+            corpus_p = jnp.roll(corpus_p, shift, axis=0)
+    else:
+        cp = pad_rows(np.asarray(corpus), c_pad)
+        if shift:
+            cp = np.roll(cp, shift, axis=0)
+        corpus_p = jnp.asarray(cp, dtype=dtype)
+    queries_p = pad_rows_any(queries, q_pad, dtype=dtype)
+    qids_p = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32)
+
+    c_sharding = NamedSharding(mesh, P(axis))
+    q_sharding = NamedSharding(mesh, _query_spec(q_axis, axis))
+    block = jax.device_put(corpus_p, c_sharding)
+    block_ids = jax.device_put(corpus_ids, c_sharding)
+    queries_p = jax.device_put(queries_p, q_sharding)
+    qids_p = jax.device_put(qids_p, q_sharding)
+    carry_d = jax.device_put(carry_d, q_sharding)
+    carry_i = jax.device_put(carry_i, q_sharding)
+
+    total = ring_n if stop_after_rounds is None else min(
+        ring_n, start_round + stop_after_rounds
+    )
+    for r in range(start_round, total):
+        block, block_ids, carry_d, carry_i = _ring_one_round(
+            queries_p,
+            qids_p,
+            block,
+            block_ids,
+            carry_d,
+            carry_i,
+            cfg,
+            overlap,
+            mesh,
+            axis,
+            q_tile,
+            c_tile,
+            q_axis=q_axis,
+            rotate=(r + 1 < ring_n),
+        )
+        done = r + 1
+        if checkpoint_dir is not None and (
+            done % save_every == 0 or done == ring_n
+        ):
+            carry_d.block_until_ready()
+            save_checkpoint(
+                checkpoint_dir,
+                KNNCheckpoint(
+                    carry_d=np.asarray(carry_d),
+                    carry_i=np.asarray(carry_i),
+                    tiles_done=done,
+                    fingerprint=fp,
+                ),
+            )
+        if progress_cb is not None:
+            progress_cb(done, ring_n)
+
+    return carry_d[:nq], carry_i[:nq]

@@ -86,10 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace for TensorBoard/XProf")
     o.add_argument("--checkpoint-dir", default=None,
-                   help="round-granular checkpoint/resume state directory "
-                   "(serial backend)")
-    o.add_argument("--save-every", type=int, default=8,
-                   help="corpus tiles per checkpoint round")
+                   help="round-granular checkpoint/resume state directory; "
+                   "ring backends checkpoint the sharded carry per ring "
+                   "round, serial/pallas per corpus-tile round")
+    o.add_argument("--save-every", type=int, default=None,
+                   help="checkpoint cadence: corpus tiles for the serial "
+                   "path (default 8), ring rounds for ring backends "
+                   "(default 1 — a ring has only as many rounds as devices)")
     o.add_argument("-q", "--quiet", action="store_true")
     o.add_argument("--recall-vs-serial", action="store_true",
                    help="also run the serial backend and report recall@k of "
@@ -247,12 +250,6 @@ def main(argv=None) -> int:
                 f"error: --dp requires a ring backend (got --backend "
                 f"{args.backend}; serial/pallas ignore the mesh)"
             )
-        if args.checkpoint_dir:
-            raise SystemExit(
-                "error: --dp cannot be combined with --checkpoint-dir "
-                "(the resumable driver runs the serial path, which ignores "
-                "the mesh)"
-            )
         total = args.devices or len(jax.devices())
         if total % args.dp:
             raise SystemExit(
@@ -273,7 +270,6 @@ def main(argv=None) -> int:
     with profile_trace(args.profile):
         with timer.phase("knn"):
             if args.checkpoint_dir:
-                from mpi_knn_tpu.backends.resumable import all_knn_resumable
                 from mpi_knn_tpu.types import KNNResult
 
                 q_arr = queries if queries is not None else X
@@ -282,11 +278,30 @@ def main(argv=None) -> int:
                     if queries is not None
                     else np.arange(len(X), dtype=np.int32)
                 )
-                d, i = all_knn_resumable(
-                    X, q_arr, q_ids, cfg,
-                    checkpoint_dir=args.checkpoint_dir,
-                    save_every=args.save_every,
-                )
+                resolved = resolve_backend(cfg, mesh)
+                if resolved in ("ring", "ring-overlap"):
+                    # distributed resume: carry checkpointed per ring round
+                    from mpi_knn_tpu.backends.ring_resumable import (
+                        all_knn_ring_resumable,
+                    )
+
+                    d, i = all_knn_ring_resumable(
+                        X, q_arr, q_ids, cfg,
+                        mesh=mesh,
+                        overlap=(resolved == "ring-overlap"),
+                        checkpoint_dir=args.checkpoint_dir,
+                        save_every=args.save_every or 1,
+                    )
+                else:
+                    from mpi_knn_tpu.backends.resumable import (
+                        all_knn_resumable,
+                    )
+
+                    d, i = all_knn_resumable(
+                        X, q_arr, q_ids, cfg,
+                        checkpoint_dir=args.checkpoint_dir,
+                        save_every=args.save_every or 8,
+                    )
                 result = KNNResult(dists=d, ids=i)
             else:
                 result = all_knn(X, queries=queries, config=cfg, mesh=mesh)
@@ -312,18 +327,21 @@ def main(argv=None) -> int:
                 report.notes["predictions"] = preds.tolist()
 
     if args.recall_vs_serial:
-        if report.backend == "serial" or args.checkpoint_dir:
+        if report.backend == "serial" or (
+            args.checkpoint_dir
+            and report.backend not in ("ring", "ring-overlap")
+        ):
             # comparing serial math against itself is vacuous (the
-            # checkpoint/resume driver always runs the serial path); make
+            # non-ring checkpoint/resume driver runs the serial path); make
             # that visible instead of reporting a hollow 1.0 for a backend
-            # that never ran
+            # that never ran. Ring backends DO run ring math under
+            # --checkpoint-dir (ring_resumable), so those compare for real.
             report.recall_vs_baseline = 1.0
             if not args.quiet:
                 why = ("resumable runs serial math"
                        if args.checkpoint_dir else "selected backend IS serial")
                 print(f"recall-vs-serial: {why} (trivially 1.0); pick "
-                      "--backend ring/ring-overlap/pallas without "
-                      "--checkpoint-dir to compare")
+                      "--backend ring/ring-overlap/pallas to compare")
         else:
             from mpi_knn_tpu.utils.report import recall_at_k
 

@@ -16,6 +16,28 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def center_for_l2(corpus, queries, all_pairs: bool):
+    """Mean-center corpus (and queries consistently) before L2 distances.
+
+    Translation leaves L2 distances unchanged, but cancellation error in the
+    ‖x‖²+‖y‖²−2xy matmul form scales with the *centered* norms — centering
+    keeps fp noise (and the relative zero-distance threshold, ops.topk) tight
+    even when the data sits far from the origin. One shared implementation
+    for api.all_knn and both resumable drivers: device-resident inputs are
+    centered on device (no host bounce; f64 stays f64 when x64 is on), host
+    inputs keep the f64 mean for the debug mode.
+    """
+    if isinstance(corpus, jax.Array):
+        acc = jnp.float64 if corpus.dtype == jnp.float64 else jnp.float32
+        mu = jnp.mean(corpus, axis=0, dtype=acc)
+    else:
+        mu = np.asarray(corpus, dtype=np.float64).mean(axis=0)
+    corpus = corpus - mu
+    queries = corpus if all_pairs else queries - mu
+    return corpus, queries
 
 
 def _acc_dtype(x: jax.Array) -> jnp.dtype:

@@ -26,19 +26,35 @@ from mpi_knn_tpu.config import KNNConfig
 _STATE_FILE = "knn_state.npz"
 
 
-def fingerprint(corpus: np.ndarray, queries: np.ndarray, cfg: KNNConfig) -> str:
-    """Cheap, stable identity of (data, config): shapes + strided samples +
-    config fields. Not cryptographic — guards against resuming with the
-    wrong data/config, not against adversaries."""
+def _array_signature(arr) -> bytes:
+    """Full shape + dtype + a strided ~4096-element content sample covering
+    the whole array. The SAME flat-stride scheme runs for host and device
+    arrays (device-side reshape+slice, so only the small sample crosses to
+    host), so the fingerprint is residency-independent: a run checkpointed
+    with a numpy corpus resumes when re-invoked with the identical corpus
+    already on device, and vice versa."""
+    shape, dtype = tuple(arr.shape), str(arr.dtype)
+    n = 1
+    for dim in shape:
+        n *= dim
+    step = max(1, n // 4096)
+    if isinstance(arr, np.ndarray):
+        sample = np.ascontiguousarray(
+            np.ascontiguousarray(arr).reshape(-1)[::step]
+        )
+    else:
+        sample = np.asarray(arr.reshape(-1)[::step])
+    return str(shape).encode() + str(dtype).encode() + sample.tobytes()
+
+
+def fingerprint(corpus, queries, cfg: KNNConfig) -> str:
+    """Cheap, stable identity of (data, config): full shapes + strided
+    content samples + config fields. Not cryptographic — guards against
+    resuming with the wrong data/config, not against adversaries."""
     h = hashlib.sha256()
     h.update(json.dumps(dataclasses.asdict(cfg), sort_keys=True).encode())
     for arr in (corpus, queries):
-        arr = np.ascontiguousarray(arr)
-        h.update(str(arr.shape).encode())
-        h.update(str(arr.dtype).encode())
-        flat = arr.reshape(-1)
-        step = max(1, flat.size // 4096)
-        h.update(np.ascontiguousarray(flat[::step]).tobytes())
+        h.update(_array_signature(arr))
     return h.hexdigest()
 
 

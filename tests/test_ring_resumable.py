@@ -1,0 +1,141 @@
+"""Ring checkpoint/resume (SURVEY.md §6): kill the rotation at an arbitrary
+round, resume from the saved carry, and land bit-identical to an
+uninterrupted run — the recovery story the reference's MPI job (abort on any
+rank failure, stdout-only results) cannot tell.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu import KNNConfig, all_knn
+from mpi_knn_tpu.backends.ring_resumable import all_knn_ring_resumable
+from mpi_knn_tpu.parallel.mesh import make_mesh2d, make_ring_mesh
+
+
+def _data(rng, m=96, d=12):
+    return rng.standard_normal((m, d)).astype(np.float32)
+
+
+def _ids(m):
+    return np.arange(m, dtype=np.int32)
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_ring_resumable_matches_serial(rng, tmp_path, overlap):
+    X = _data(rng)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8)
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, overlap=overlap,
+        checkpoint_dir=tmp_path / "ck",
+    )
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_ring_resumable_fault_injection(rng, tmp_path):
+    """Kill after 3 of 8 rounds; the resumed run completes identically."""
+    X = _data(rng)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8)
+    ck = tmp_path / "ck"
+    rounds = []
+    partial_d, partial_i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck,
+        stop_after_rounds=3, progress_cb=lambda r, t: rounds.append(r),
+    )
+    assert rounds == [1, 2, 3]
+
+    rounds2 = []
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck,
+        progress_cb=lambda r, t: rounds2.append(r),
+    )
+    assert rounds2 == [4, 5, 6, 7, 8]  # resumed, not restarted
+
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+    np.testing.assert_allclose(
+        np.asarray(want.dists), np.asarray(d), rtol=1e-5
+    )
+
+
+def test_ring_resumable_2d_mesh(rng, tmp_path):
+    X = _data(rng, m=80)
+    cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8)
+    mesh = make_mesh2d(2, 4)
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, mesh=mesh, checkpoint_dir=ck,
+        stop_after_rounds=2,
+    )
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, mesh=mesh, checkpoint_dir=ck
+    )
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_checkpoint_rejected_for_different_mesh(rng, tmp_path):
+    """A carry saved on a 4-ring must not resume on an 8-ring (block layout
+    differs); the fingerprint mismatch forces a clean restart."""
+    X = _data(rng, m=64)
+    cfg = KNNConfig(k=3, query_tile=4, corpus_tile=8)
+    ck = tmp_path / "ck"
+    mesh4 = make_ring_mesh(4)
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, mesh=mesh4, checkpoint_dir=ck,
+        stop_after_rounds=2,
+    )
+    rounds = []
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck,  # default 8-ring
+        progress_cb=lambda r, t: rounds.append(r),
+    )
+    assert rounds[0] == 1  # restarted from round 0, not resumed
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_query_mode_resumable(rng, tmp_path):
+    X, Q = _data(rng, m=64), _data(rng, m=24)
+    cfg = KNNConfig(k=3, query_tile=4, corpus_tile=8)
+    qids = np.full(len(Q), -1, np.int32)
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, Q, qids, cfg, checkpoint_dir=ck, stop_after_rounds=4
+    )
+    d, i = all_knn_ring_resumable(X, Q, qids, cfg, checkpoint_dir=ck)
+    want = all_knn(X, queries=Q, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_fingerprint_residency_independent(rng):
+    """Same data, host vs device residency -> same fingerprint (a resume
+    must survive the caller switching between numpy and device arrays)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_knn_tpu.utils.checkpoint import fingerprint
+
+    X = _data(rng, m=70, d=9)
+    Q = _data(rng, m=20, d=9)
+    cfg = KNNConfig(k=3)
+    host = fingerprint(X, Q, cfg)
+    dev = fingerprint(jax.device_put(jnp.asarray(X)), jnp.asarray(Q), cfg)
+    assert host == dev
+    # and content changes anywhere (not just a prefix) change it
+    X2 = X.copy()
+    X2[-1, -1] += 1.0
+    assert fingerprint(X2, Q, cfg) != host
+
+
+def test_resumable_rejects_3d_mesh(rng):
+    import jax
+    import numpy as np_
+    from jax.sharding import Mesh
+
+    X = _data(rng, m=16, d=4)
+    mesh3 = Mesh(np_.asarray(jax.devices()).reshape(2, 2, 2), ("a", "b", "c"))
+    with pytest.raises(ValueError, match="1-D .* or 2-D"):
+        all_knn_ring_resumable(
+            X, X, _ids(len(X)), KNNConfig(k=2), mesh=mesh3
+        )
