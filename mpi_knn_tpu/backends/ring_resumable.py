@@ -106,6 +106,18 @@ def _ring_one_round(
     return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
 
 
+def _fetch_global(a) -> np.ndarray:
+    """Host copy of a possibly cross-process-sharded array. np.asarray on an
+    array spanning non-addressable devices raises; allgather first so every
+    process holds the full carry (the reference's analog: every rank printing
+    its own partial results — here every host can write a whole checkpoint)."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        a = multihost_utils.process_allgather(a, tiled=True)
+    return np.asarray(a)
+
+
 def all_knn_ring_resumable(
     corpus,
     queries,
@@ -219,15 +231,20 @@ def all_knn_ring_resumable(
             done % save_every == 0 or done == ring_n
         ):
             carry_d.block_until_ready()
-            save_checkpoint(
-                checkpoint_dir,
-                KNNCheckpoint(
-                    carry_d=np.asarray(carry_d),
-                    carry_i=np.asarray(carry_i),
-                    tiles_done=done,
-                    fingerprint=fp,
-                ),
-            )
+            # multi-host: the carry spans processes; allgather the full array
+            # (every process sees it), then only process 0 writes — the
+            # checkpoint dir is assumed shared/visible on resume
+            cd_h, ci_h = _fetch_global(carry_d), _fetch_global(carry_i)
+            if jax.process_index() == 0:
+                save_checkpoint(
+                    checkpoint_dir,
+                    KNNCheckpoint(
+                        carry_d=cd_h,
+                        carry_i=ci_h,
+                        tiles_done=done,
+                        fingerprint=fp,
+                    ),
+                )
         if progress_cb is not None:
             progress_cb(done, ring_n)
 

@@ -124,6 +124,13 @@ def _load_data(args):
 
         X, y, src = load_mnist(m=args.limit or 60000)
         return X, y, f"mnist({src})"
+    if spec.endswith((".fvecs", ".bvecs")):
+        from mpi_knn_tpu.data.vecs import read_vecs
+
+        try:
+            return read_vecs(spec, limit=args.limit), None, spec
+        except (FileNotFoundError, ValueError) as e:
+            raise SystemExit(f"error: {e}")
     from mpi_knn_tpu.data.matfile import load_corpus_mat
 
     try:
@@ -141,6 +148,13 @@ def _load_data(args):
 def _load_queries(path):
     if path.endswith(".npy"):
         return np.load(path)
+    if path.endswith((".fvecs", ".bvecs")):
+        from mpi_knn_tpu.data.vecs import read_vecs
+
+        try:
+            return read_vecs(path)
+        except (FileNotFoundError, ValueError) as e:
+            raise SystemExit(f"error: {e}")
     from mpi_knn_tpu.data.matfile import read_mat
 
     data = read_mat(path)

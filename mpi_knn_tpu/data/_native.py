@@ -1,0 +1,48 @@
+"""Shared build-and-load scaffolding for the native C++ data-layer libraries
+(native/*.cpp — MAT v5 reader, vecs reader). One implementation of the
+"make on demand, latch failure, bind symbols" dance so build-logic fixes
+land in one place."""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def load_native(
+    so_name: str,
+    bind: Callable[[ctypes.CDLL], None],
+    build: bool = True,
+) -> Optional[ctypes.CDLL]:
+    """Load native/build/<so_name>, running ``make`` once if absent.
+
+    Returns the bound CDLL, or None when the library can't be built/loaded
+    (callers fall back to their NumPy paths). Failure is latched per-library
+    so a missing toolchain costs one subprocess attempt per process."""
+    if so_name in _cache:
+        return _cache[so_name]
+    lib_path = NATIVE_DIR / "build" / so_name
+    if not lib_path.exists() and build:
+        try:
+            subprocess.run(
+                ["make", "-C", str(NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError):
+            _cache[so_name] = None
+            return None
+    if not lib_path.exists():
+        _cache[so_name] = None
+        return None
+    lib = ctypes.CDLL(str(lib_path))
+    bind(lib)
+    _cache[so_name] = lib
+    return lib

@@ -20,16 +20,12 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import zlib
 from pathlib import Path
 from typing import Dict, Optional
 
 import numpy as np
 
-_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
-_NATIVE_DIR = _REPO_ROOT / "native"
-_LIB_PATH = _NATIVE_DIR / "build" / "libtknn_matio.so"
 
 # MAT v5 data-type tags / array classes
 _MI_INT8, _MI_UINT8, _MI_INT16, _MI_UINT16 = 1, 2, 3, 4
@@ -183,33 +179,7 @@ def read_mat_numpy(path) -> Dict[str, np.ndarray]:
 
 # ---------------------------------------------------------------- native reader
 
-_native_lib = None
-_native_build_failed = False
-
-
-def load_native_lib(build: bool = True):
-    """Load (building if needed) the C++ MAT reader; None if unavailable."""
-    global _native_lib, _native_build_failed
-    if _native_lib is not None:
-        return _native_lib
-    if _native_build_failed:
-        return None
-    if not _LIB_PATH.exists() and build:
-        try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (subprocess.SubprocessError, OSError):
-            _native_build_failed = True
-            return None
-    if not _LIB_PATH.exists():
-        _native_build_failed = True
-        return None
-
-    lib = ctypes.CDLL(str(_LIB_PATH))
+def _bind(lib: ctypes.CDLL) -> None:
     lib.tknn_mat_open.restype = ctypes.c_void_p
     lib.tknn_mat_open.argtypes = [ctypes.c_char_p]
     lib.tknn_mat_error.restype = ctypes.c_char_p
@@ -233,8 +203,13 @@ def load_native_lib(build: bool = True):
     ]
     lib.tknn_mat_close.restype = None
     lib.tknn_mat_close.argtypes = [ctypes.c_void_p]
-    _native_lib = lib
-    return lib
+
+
+def load_native_lib(build: bool = True):
+    """Load (building if needed) the C++ MAT reader; None if unavailable."""
+    from mpi_knn_tpu.data._native import load_native
+
+    return load_native("libtknn_matio.so", _bind, build=build)
 
 
 def read_mat_native(path) -> Dict[str, np.ndarray]:

@@ -1,0 +1,127 @@
+// Host data layer: reader for the TexMex *.fvecs / *.bvecs / *.ivecs vector
+// formats — the on-disk format of the SIFT1M/GIST1M ANN benchmark corpora
+// (BASELINE.md "SIFT1M (1M×128) multi-host" config). The reference project
+// ships only MAT-file I/O (/root/reference/knn-serial.c:38-52); this native
+// component extends the rebuild's data layer to the benchmark datasets the
+// perf targets are defined on, in the same C++ style as matio.cpp.
+//
+// Format (little-endian, per vector): int32 dimension d, then d components —
+// float32 (fvecs), uint8 (bvecs), or int32 (ivecs, used for ground-truth
+// neighbor-id files). All rows must share d.
+//
+// C ABI for the ctypes binding in mpi_knn_tpu/data/vecs.py. Output is always
+// float32 for f/b kinds (bvecs widened) and int32 for i. Streams the file in
+// chunks — no whole-file buffer — so SIFT1B-scale files read with O(chunk)
+// host memory.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct VecsResult {
+  std::vector<uint8_t> data;  // packed rows, out dtype
+  int64_t rows = 0;
+  int64_t dim = 0;
+  std::string error;
+};
+
+size_t comp_size(char kind) {
+  switch (kind) {
+    case 'f':
+    case 'i':
+      return 4;
+    case 'b':
+      return 1;
+    default:
+      return 0;
+  }
+}
+
+VecsResult* read_vecs(const char* path, char kind, int64_t limit) {
+  auto* r = new VecsResult();
+  size_t csize = comp_size(kind);
+  if (csize == 0) {
+    r->error = std::string("unknown vecs kind '") + kind + "'";
+    return r;
+  }
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    r->error = "cannot open file";
+    return r;
+  }
+
+  std::vector<uint8_t> rowbuf;
+  while (limit < 0 || r->rows < limit) {
+    int32_t d;
+    size_t got = fread(&d, 1, 4, f);
+    if (got == 0) break;  // clean EOF at a row boundary
+    if (got != 4) {
+      r->error = "truncated dimension field at row " + std::to_string(r->rows);
+      break;
+    }
+    if (d <= 0 || d > (1 << 24)) {
+      r->error = "implausible dimension " + std::to_string(d) + " at row " +
+                 std::to_string(r->rows);
+      break;
+    }
+    if (r->rows == 0) {
+      r->dim = d;
+    } else if (d != r->dim) {
+      r->error = "inconsistent dimension (" + std::to_string(d) + " vs " +
+                 std::to_string(r->dim) + ") at row " + std::to_string(r->rows);
+      break;
+    }
+    rowbuf.resize(csize * d);
+    if (fread(rowbuf.data(), 1, rowbuf.size(), f) != rowbuf.size()) {
+      r->error = "truncated row " + std::to_string(r->rows);
+      break;
+    }
+    if (kind == 'b') {
+      // widen uint8 -> float32
+      size_t base = r->data.size();
+      r->data.resize(base + 4 * d);
+      float* out = reinterpret_cast<float*>(r->data.data() + base);
+      for (int32_t j = 0; j < d; ++j) out[j] = rowbuf[j];
+    } else {
+      r->data.insert(r->data.end(), rowbuf.begin(), rowbuf.end());
+    }
+    r->rows += 1;
+  }
+  fclose(f);
+  if (!r->error.empty()) {
+    r->data.clear();
+    r->rows = 0;
+    r->dim = 0;
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tknn_vecs_read(const char* path, char kind, int64_t limit) {
+  return read_vecs(path, kind, limit);
+}
+
+const char* tknn_vecs_error(void* h) {
+  auto* r = static_cast<VecsResult*>(h);
+  return r->error.empty() ? nullptr : r->error.c_str();
+}
+
+int64_t tknn_vecs_rows(void* h) { return static_cast<VecsResult*>(h)->rows; }
+int64_t tknn_vecs_dim(void* h) { return static_cast<VecsResult*>(h)->dim; }
+
+// copies rows*dim components into `out` (float32 for f/b, int32 for i)
+void tknn_vecs_copy(void* h, void* out) {
+  auto* r = static_cast<VecsResult*>(h);
+  memcpy(out, r->data.data(), r->data.size());
+}
+
+void tknn_vecs_close(void* h) { delete static_cast<VecsResult*>(h); }
+
+}  // extern "C"
