@@ -20,6 +20,7 @@ from mpi_knn_tpu.backends.serial import (
     knn_chunk_update,
     prepare_tiles,
 )
+from mpi_knn_tpu.utils.logs import log
 from mpi_knn_tpu.utils.checkpoint import (
     KNNCheckpoint,
     fingerprint,
@@ -74,6 +75,8 @@ def all_knn_resumable(
             start_tile = state.tiles_done
             carry_d = jnp.asarray(state.carry_d, dtype=acc)
             carry_i = jnp.asarray(state.carry_i)
+            log.info("resuming serial stream at tile %d/%d from %s",
+                     start_tile, tiles, checkpoint_dir)
 
     for t0 in range(start_tile, tiles, save_every):
         t1 = min(t0 + save_every, tiles)

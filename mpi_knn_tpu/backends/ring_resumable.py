@@ -35,12 +35,14 @@ from mpi_knn_tpu.backends.ring import (
     ring_tiles,
 )
 from mpi_knn_tpu.ops.topk import init_topk
+from mpi_knn_tpu.parallel.distributed import fetch_global
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
     pad_rows,
     pad_rows_any,
 )
+from mpi_knn_tpu.utils.logs import log
 from mpi_knn_tpu.utils.checkpoint import (
     KNNCheckpoint,
     fingerprint,
@@ -106,18 +108,6 @@ def _ring_one_round(
     return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
 
 
-def _fetch_global(a) -> np.ndarray:
-    """Host copy of a possibly cross-process-sharded array. np.asarray on an
-    array spanning non-addressable devices raises; allgather first so every
-    process holds the full carry (the reference's analog: every rank printing
-    its own partial results — here every host can write a whole checkpoint)."""
-    if isinstance(a, jax.Array) and not a.is_fully_addressable:
-        from jax.experimental import multihost_utils
-
-        a = multihost_utils.process_allgather(a, tiled=True)
-    return np.asarray(a)
-
-
 def all_knn_ring_resumable(
     corpus,
     queries,
@@ -175,6 +165,8 @@ def all_knn_ring_resumable(
             start_round = state.tiles_done  # field reused as rounds_done
             carry_d = jnp.asarray(state.carry_d, dtype=acc)
             carry_i = jnp.asarray(state.carry_i)
+            log.info("resuming ring at round %d/%d from %s",
+                     start_round, ring_n, checkpoint_dir)
 
     # after r rounds device i holds block (i − r) mod ring_n: roll the padded
     # corpus r blocks forward so sharding lands blocks correctly on resume.
@@ -234,7 +226,7 @@ def all_knn_ring_resumable(
             # multi-host: the carry spans processes; allgather the full array
             # (every process sees it), then only process 0 writes — the
             # checkpoint dir is assumed shared/visible on resume
-            cd_h, ci_h = _fetch_global(carry_d), _fetch_global(carry_i)
+            cd_h, ci_h = fetch_global(carry_d), fetch_global(carry_i)
             if jax.process_index() == 0:
                 save_checkpoint(
                     checkpoint_dir,
@@ -245,6 +237,7 @@ def all_knn_ring_resumable(
                         fingerprint=fp,
                     ),
                 )
+        log.debug("ring round %d/%d done", done, ring_n)
         if progress_cb is not None:
             progress_cb(done, ring_n)
 

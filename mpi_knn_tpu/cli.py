@@ -94,6 +94,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "path (default 8), ring rounds for ring backends "
                    "(default 1 — a ring has only as many rounds as devices)")
     o.add_argument("-q", "--quiet", action="store_true")
+    o.add_argument("-v", "--verbose", action="count", default=0,
+                   help="-v: INFO (phase/checkpoint events, per-host "
+                   "prefixed), -vv: DEBUG (per-round progress)")
     o.add_argument("--recall-vs-serial", action="store_true",
                    help="also run the serial backend and report recall@k of "
                    "the selected backend against it (the acceptance gate, "
@@ -165,17 +168,11 @@ def _load_queries(path):
 
 
 def _to_host(a) -> np.ndarray:
-    """Fetch a result array to host numpy. Multi-host runs produce globally
-    sharded arrays that are not fully addressable from one process —
-    np.asarray would raise — so those are allgathered first (every process
-    gets the full array, mirroring the reference's per-rank stdout model)."""
-    import jax
+    """Fetch a result array to host numpy (multi-host gather handled by
+    parallel.distributed.fetch_global — one implementation)."""
+    from mpi_knn_tpu.parallel.distributed import fetch_global
 
-    if isinstance(a, jax.Array) and not a.is_fully_addressable:
-        from jax.experimental import multihost_utils
-
-        a = multihost_utils.process_allgather(a, tiled=True)
-    return np.asarray(a)
+    return fetch_global(a)
 
 
 def main(argv=None) -> int:
@@ -185,6 +182,10 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    from mpi_knn_tpu.utils.logs import log, setup_logging
+
+    setup_logging(args.verbose, quiet=args.quiet)
 
     import os
 
@@ -221,6 +222,8 @@ def main(argv=None) -> int:
     timer = PhaseTimer()
     with timer.phase("load"):
         X, labels, source = _load_data(args)
+        log.info("loaded %s: shape=%s labels=%s", source, X.shape,
+                 labels is not None)
         if args.limit:
             X = X[: args.limit]
             labels = labels[: args.limit] if labels is not None else None

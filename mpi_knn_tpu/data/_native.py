@@ -30,8 +30,11 @@ def load_native(
     lib_path = NATIVE_DIR / "build" / so_name
     if not lib_path.exists() and build:
         try:
+            # build only the requested artifact: a failure in another
+            # library's rule (e.g. matio's zlib dependency) must not block
+            # this one
             subprocess.run(
-                ["make", "-C", str(NATIVE_DIR)],
+                ["make", "-C", str(NATIVE_DIR), f"build/{so_name}"],
                 check=True,
                 capture_output=True,
                 timeout=120,

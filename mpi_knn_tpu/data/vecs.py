@@ -84,16 +84,21 @@ def read_vecs_numpy(path, limit: Optional[int] = None) -> np.ndarray:
     path = Path(path)
     kind = _kind_for(path)
     out_dtype = np.int32 if kind == "i" else np.float32
-    raw = np.fromfile(path, dtype=np.uint8)
-    if raw.size == 0 or limit == 0:
+    with open(path, "rb") as f:
+        head = f.read(4)
+    if len(head) == 0 or limit == 0:
         return np.empty((0, 0), out_dtype)
-    if raw.size < 4:
+    if len(head) < 4:
         raise ValueError(f"{path}: truncated dimension field at row 0")
-    d = int(raw[:4].view(np.int32)[0])
+    d = int(np.frombuffer(head, np.int32)[0])
     if d <= 0 or d > (1 << 24):
         raise ValueError(f"{path}: implausible dimension {d} at row 0")
     comp = 1 if kind == "b" else 4
     stride = 4 + d * comp
+    # read only what the limit needs — a SIFT1B-scale file with a small
+    # limit must not be slurped whole (the native path streams likewise)
+    count = -1 if limit is None else limit * stride
+    raw = np.fromfile(path, dtype=np.uint8, count=count)
     full_rows = raw.size // stride
     rows = full_rows if limit is None else min(limit, full_rows)
     if (limit is None or full_rows < limit) and raw.size % stride:

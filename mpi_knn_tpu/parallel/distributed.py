@@ -22,6 +22,20 @@ import os
 from typing import Optional
 
 import jax
+import numpy as np
+
+
+def fetch_global(a) -> np.ndarray:
+    """Host copy of a possibly cross-process-sharded array. ``np.asarray``
+    on an array spanning non-addressable devices raises; allgather first so
+    every process holds the full array (the reference's analog: every rank
+    printing its own partial results — here every host sees the whole
+    thing). Single-host arrays pass straight through."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        a = multihost_utils.process_allgather(a, tiled=True)
+    return np.asarray(a)
 
 log = logging.getLogger("mpi_knn_tpu")
 

@@ -1,0 +1,59 @@
+"""Logging setup with per-host prefixes (SURVEY.md §6 "Metrics / logging":
+the reference's only tracing is anonymous printf debug lines like
+"DONE"/"done"/"DOne" per rank per round,
+``/root/reference/mpi-knn-parallel_non_blocking.c:208,217,226`` — no way to
+tell which rank said what. Every record here carries ``[hostI/N]``.)
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("mpi_knn_tpu")
+
+
+class _HostPrefix(logging.Filter):
+    """Resolves the [hostI/N] prefix lazily at EMIT time, not setup time.
+
+    Setup-time resolution would (a) initialize the JAX backend before
+    ``jax.distributed.initialize`` — which must run first in multi-host jobs
+    — and (b) freeze the prefix at host0/1 captured pre-init. The CLI's
+    first log record is emitted after multi-host init, so emit-time lookup
+    sees the real process index."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            import jax
+
+            record.host = f"host{jax.process_index()}/{jax.process_count()}"
+        except Exception:
+            record.host = "host0/1"
+        return True
+
+
+def setup_logging(verbosity: int = 0, quiet: bool = False) -> logging.Logger:
+    """Configure the framework logger: WARNING by default, INFO at -v,
+    DEBUG at -vv; records carry this host's process index so multi-host
+    output interleaves legibly. Safe to call before
+    ``jax.distributed.initialize`` — no JAX call happens here."""
+    level = logging.WARNING
+    if quiet:
+        level = logging.ERROR
+    elif verbosity >= 2:
+        level = logging.DEBUG
+    elif verbosity == 1:
+        level = logging.INFO
+
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s [%(host)s] %(name)s %(levelname)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    handler.addFilter(_HostPrefix())
+    log.handlers.clear()
+    log.addHandler(handler)
+    log.setLevel(level)
+    log.propagate = False
+    return log

@@ -10,9 +10,10 @@
 // neighbor-id files). All rows must share d.
 //
 // C ABI for the ctypes binding in mpi_knn_tpu/data/vecs.py. Output is always
-// float32 for f/b kinds (bvecs widened) and int32 for i. Streams the file in
-// chunks — no whole-file buffer — so SIFT1B-scale files read with O(chunk)
-// host memory.
+// float32 for f/b kinds (bvecs widened) and int32 for i. The INPUT is
+// streamed row by row (no whole-file buffer) and reading stops at `limit`,
+// so memory is bounded by the requested output (rows x dim x 4 bytes here,
+// plus the caller's numpy copy) — pass a limit when sampling huge files.
 
 #include <cstdint>
 #include <cstdio>
