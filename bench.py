@@ -34,6 +34,7 @@ design (speed bought with recall does not count).
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -42,6 +43,14 @@ import numpy as np
 NORTH_STAR_SECONDS = 1.0  # on 8 chips (v5e-8)
 NORTH_STAR_CHIPS = 8
 RECALL_GATE = 0.999
+
+
+def metric_name() -> str:
+    """One construction of the series name, shared by the success and
+    watchdog paths so a failure always lands in the real series."""
+    m = int(os.environ.get("BENCH_M", "60000"))
+    k = int(os.environ.get("BENCH_K", "10"))
+    return f"mnist{m // 1000}k_allknn_k{k}_seconds"
 
 
 def oracle_topk(X: np.ndarray, sample: np.ndarray, k: int) -> np.ndarray:
@@ -115,12 +124,13 @@ def main() -> int:
     vs = (target_here / value) if recall >= RECALL_GATE else 0.0
 
     line = {
-        "metric": f"mnist{m // 1000}k_allknn_k{k}_seconds",
+        "metric": metric_name(),
         "value": round(value, 4),
         "unit": "s",
         "vs_baseline": round(vs, 3),
     }
-    print(json.dumps(line))
+    _COMPLETED.set()  # suppress the watchdog from here on
+    print(json.dumps(line), flush=True)
     # context for humans / the judge, on stderr so stdout stays one line
     print(
         json.dumps(
@@ -143,22 +153,29 @@ def main() -> int:
     return 0
 
 
+_COMPLETED = threading.Event()
+
+
 def _watchdog_fire():
     # a wedged device transport hangs inside a native runtime call that
     # never returns — a signal handler would never run (the interpreter
     # can't regain control), so a daemon THREAD emits an honest failure
     # line (vs_baseline 0) and hard-exits instead of hanging the harness
-    m = int(os.environ.get("BENCH_M", "60000"))
-    k = int(os.environ.get("BENCH_K", "10"))
+    if _COMPLETED.is_set():
+        return  # raced with a just-finished run: its success line stands
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", "480"))
     print(
         json.dumps(
             {
-                # same series name a successful run reports, so the failure
-                # lands as a data point in the real metric
-                "metric": f"mnist{m // 1000}k_allknn_k{k}_seconds",
-                "value": -1.0,
+                # same series name a successful run reports; value is the
+                # timeout itself ("took at least this long") so
+                # lower-is-better aggregations are not poisoned by a
+                # negative sentinel
+                "metric": metric_name(),
+                "value": watchdog_s,
                 "unit": "s",
                 "vs_baseline": 0.0,
+                "failed": True,
             }
         ),
         flush=True,
@@ -173,8 +190,6 @@ def _watchdog_fire():
 
 
 if __name__ == "__main__":
-    import threading
-
     # generous enough for first-compile (~40 s) + the run, tight enough
     # that a wedged tunnel doesn't hang the harness forever
     watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "480"))
@@ -183,9 +198,12 @@ if __name__ == "__main__":
         t = threading.Timer(watchdog_s, _watchdog_fire)
         t.daemon = True
         t.start()
-    rc = main()
-    if t is not None:
-        # a run finishing near the deadline must not ALSO emit the failure
-        # line (two conflicting metric lines + os._exit(2) over a success)
-        t.cancel()
+    try:
+        rc = main()
+    finally:
+        # main sets _COMPLETED before printing its result line, so a timer
+        # that fires during the final prints is a no-op; cancel handles the
+        # not-yet-fired case (exception paths included)
+        if t is not None:
+            t.cancel()
     sys.exit(rc)

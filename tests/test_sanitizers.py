@@ -1,0 +1,82 @@
+"""Sanitizer-style checks (SURVEY.md §6 "Race detection / sanitizers"):
+the reference has real data races and no tooling (Q2); here the functional
+model is race-free by construction, and these tests run the numerics under
+``jax_debug_nans`` (the JAX analog of a sanitizer pass — any NaN produced
+inside a jitted computation raises immediately) plus dtype sweeps that pin
+every backend to the serial ground truth.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_knn_tpu import KNNConfig, all_knn, knn_classify
+
+
+def _data(rng, m=64, d=12):
+    return rng.standard_normal((m, d)).astype(np.float32)
+
+
+def test_no_nans_under_debug_nans(rng):
+    """The full pipeline (distances -> masks -> top-k -> vote) must not
+    produce NaNs even with duplicate rows and zero vectors in the corpus.
+    +inf sentinels are fine; NaN would poison comparisons silently."""
+    X = _data(rng)
+    X[10] = X[3]  # exact duplicate (zero-distance path)
+    X[20] = 0.0  # zero vector (cosine normalization edge)
+    y = rng.integers(0, 4, size=len(X)).astype(np.int32)
+    jax.config.update("jax_debug_nans", True)
+    try:
+        for metric in ("l2", "cosine"):
+            res = all_knn(X, config=KNNConfig(k=5, metric=metric,
+                                              query_tile=16, corpus_tile=32))
+            cls = knn_classify(res, y, num_classes=4)
+            np.asarray(cls.predictions)
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float64"])
+@pytest.mark.parametrize("backend", ["serial", "ring-overlap"])
+def test_dtype_sweep_recall(rng, dtype, backend):
+    """Every (dtype, backend) combination reaches near-perfect recall vs
+    the f64 serial ground truth on well-separated data (bf16 may flip true
+    near-ties, so the gate is recall, not bit equality)."""
+    from mpi_knn_tpu.utils.report import recall_at_k
+
+    centers = rng.standard_normal((8, 12)) * 8.0
+    labels = rng.integers(0, 8, size=64)
+    X = (centers[labels] + rng.standard_normal((64, 12)) * 0.1).astype(
+        np.float32
+    )
+    truth = all_knn(
+        X, config=KNNConfig(k=5, dtype="float64", backend="serial",
+                            query_tile=16, corpus_tile=32)
+    )
+    got = all_knn(
+        X, config=KNNConfig(k=5, dtype=dtype, backend=backend,
+                            query_tile=16, corpus_tile=32)
+    )
+    rec = recall_at_k(np.asarray(got.ids), np.asarray(truth.ids))
+    assert rec >= (0.97 if dtype == "bfloat16" else 0.999), rec
+
+
+def test_logs_prefix_and_levels(capsys):
+    import logging
+
+    from mpi_knn_tpu.utils.logs import log, setup_logging
+
+    setup_logging(verbosity=1)
+    log.info("hello")
+    err = capsys.readouterr().err
+    assert "[host0/1]" in err and "hello" in err
+    # -q drops INFO
+    setup_logging(verbosity=1, quiet=True)
+    log.info("silent")
+    assert "silent" not in capsys.readouterr().err
+    # repeated setup must not duplicate handlers
+    setup_logging(verbosity=1)
+    setup_logging(verbosity=1)
+    log.info("once")
+    assert capsys.readouterr().err.count("once") == 1
+    assert log.level == logging.INFO
