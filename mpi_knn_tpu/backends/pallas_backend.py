@@ -3,6 +3,15 @@
 Same observable semantics as the serial backend (same masks, same exclusion
 rules); differs only in where the (q × c) distance block lives (VMEM, never
 HBM). Selected with ``backend="pallas"``.
+
+Performance status (v5e, 2026-07): the XLA serial path is currently the
+fast path (0.72 s MNIST-60k all-kNN k=10, BASELINE.md); this kernel is
+correctness-verified (bit-identical to serial in tests, compiled on TPU and
+interpreted on CPU) but measured slower — its (q_tile × c_tile) grid cells
+are small (VMEM-bounded) and the k-pass min-extraction costs k VPU sweeps
+per tile. Known upgrade path: single-pass grid over query tiles with the
+corpus streamed through VMEM scratch and the carry merged in-kernel,
+profiled on hardware before replacing the default.
 """
 
 from __future__ import annotations

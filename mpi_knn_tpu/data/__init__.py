@@ -2,5 +2,13 @@ from mpi_knn_tpu.data.matfile import read_mat, write_mat
 from mpi_knn_tpu.data.synthetic import make_blobs
 from mpi_knn_tpu.data.mnist import load_mnist
 from mpi_knn_tpu.data.svd import svd_reduce
+from mpi_knn_tpu.data.vecs import read_vecs
 
-__all__ = ["read_mat", "write_mat", "make_blobs", "load_mnist", "svd_reduce"]
+__all__ = [
+    "read_mat",
+    "write_mat",
+    "make_blobs",
+    "load_mnist",
+    "svd_reduce",
+    "read_vecs",
+]
