@@ -4,14 +4,21 @@ Same observable semantics as the serial backend (same masks, same exclusion
 rules); differs only in where the (q × c) distance block lives (VMEM, never
 HBM). Selected with ``backend="pallas"``.
 
+Two kernel shapes (``cfg.pallas_variant``):
+
+- ``"tiles"``: per-(q,c)-tile local top-k, candidates written to HBM, one
+  XLA cross-tile merge (honors ``topk_method``/``recall_target`` there);
+- ``"sweep"``: the corpus-tile loop rides the minor grid axis (TPU grid
+  cells run sequentially) with the running (q_tile, k) top-k carried in
+  VMEM scratch; only the final (Q, k) leaves the kernel and the in-kernel
+  merge is always EXACT — ``topk_method="approx"`` has no effect here.
+
 Performance status (v5e, 2026-07): the XLA serial path is currently the
-fast path (0.72 s MNIST-60k all-kNN k=10, BASELINE.md); this kernel is
+fast path (0.72 s MNIST-60k all-kNN k=10, BASELINE.md); both kernels are
 correctness-verified (bit-identical to serial in tests, compiled on TPU and
-interpreted on CPU) but measured slower — its (q_tile × c_tile) grid cells
-are small (VMEM-bounded) and the k-pass min-extraction costs k VPU sweeps
-per tile. Known upgrade path: single-pass grid over query tiles with the
-corpus streamed through VMEM scratch and the carry merged in-kernel,
-profiled on hardware before replacing the default.
+interpreted on CPU) but the tiles variant measured slower and the sweep
+variant is not yet profiled on hardware — profile before making either the
+default.
 """
 
 from __future__ import annotations
@@ -23,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
-from mpi_knn_tpu.ops.pallas_knn import fused_knn_tiles
+from mpi_knn_tpu.ops.pallas_knn import fused_knn_sweep, fused_knn_tiles
 from mpi_knn_tpu.ops.topk import smallest_k
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
@@ -33,9 +40,31 @@ from mpi_knn_tpu.parallel.partition import (
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "q_tile", "c_tile", "m_corpus", "all_pairs")
+    jax.jit,
+    static_argnames=(
+        "cfg", "q_tile", "c_tile", "m_corpus", "all_pairs", "variant"
+    ),
 )
-def _pallas_all_knn(queries, corpus, cfg, q_tile, c_tile, m_corpus, all_pairs):
+def _pallas_all_knn(
+    queries, corpus, cfg, q_tile, c_tile, m_corpus, all_pairs, variant
+):
+    if variant == "sweep":
+        # the sweep kernel merges in VMEM scratch; its output IS the final
+        # top-k (exact merge — cfg.topk_method does not apply here). The
+        # caller guarantees k <= c_tile (see all_knn_pallas).
+        return fused_knn_sweep(
+            queries,
+            corpus,
+            m_corpus=m_corpus,
+            k=cfg.k,
+            q_tile=q_tile,
+            c_tile=c_tile,
+            exclude_self=cfg.exclude_self,
+            exclude_zero=cfg.exclude_zero,
+            all_pairs=all_pairs,
+            zero_eps=cfg.zero_eps,
+            precision=cfg.matmul_precision,
+        )
     outd, outi = fused_knn_tiles(
         queries,
         corpus,
@@ -90,7 +119,18 @@ def all_knn_pallas(
     corpus_p = pad_rows_any(corpus, c_pad, dtype=jnp.float32)
     queries_p = pad_rows_any(queries, q_pad, dtype=jnp.float32)
 
+    # k > c_tile is a corner both kernels COULD handle without truncation
+    # (a tile yields at most c_tile real candidates; extra extraction passes
+    # produce inf/-1 padding that later merges fill in) — but the kernels
+    # unroll k min-extraction passes at trace time, and the sweep pays that
+    # unroll TWICE per tile (tile extract + carry merge). Route the corner
+    # to the tiles variant, whose per-tile unroll is bounded by c_tile and
+    # whose XLA merge tops up across tiles.
+    variant = cfg.pallas_variant
+    if variant == "sweep" and cfg.k > c_tile:
+        variant = "tiles"
+
     best_d, best_i = _pallas_all_knn(
-        queries_p, corpus_p, cfg, q_tile, c_tile, m, all_pairs
+        queries_p, corpus_p, cfg, q_tile, c_tile, m, all_pairs, variant
     )
     return best_d[:nq], best_i[:nq]

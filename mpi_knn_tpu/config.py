@@ -16,6 +16,7 @@ BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
 TOPK_METHODS = ("exact", "approx")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
+PALLAS_VARIANTS = ("tiles", "sweep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +79,13 @@ class KNNConfig:
     num_classes: int = 10
     mesh_axis: str = "ring"
     num_devices: Optional[int] = None
+    # pallas backend kernel shape: "tiles" = per-(q,c)-tile local top-k +
+    # one XLA cross-tile merge (honors topk_method there); "sweep" = whole
+    # corpus swept on the minor grid axis with the carry in VMEM scratch,
+    # only (Q, k) leaves the kernel — its in-kernel merge is always exact,
+    # so topk_method has no effect. Both bit-identical to serial in tests;
+    # pick by profiling.
+    pallas_variant: str = "tiles"
     # hard cap on query_tile × corpus_tile elements of one distance tile —
     # the HBM-resident intermediate a backend may materialize. 2^28 f32
     # elements = 1 GiB, safely inside a 16 GiB chip alongside the corpus.
@@ -99,6 +107,11 @@ class KNNConfig:
         if self.tie_break not in TIE_BREAKS:
             raise ValueError(
                 f"tie_break must be one of {TIE_BREAKS}, got {self.tie_break!r}"
+            )
+        if self.pallas_variant not in PALLAS_VARIANTS:
+            raise ValueError(
+                f"pallas_variant must be one of {PALLAS_VARIANTS}, got "
+                f"{self.pallas_variant!r}"
             )
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")

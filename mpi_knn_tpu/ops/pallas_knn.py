@@ -30,27 +30,36 @@ _ZERO_RTOL = 1e-6  # matches ops.topk._ZERO_RTOL_DEFAULT (f32 path)
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
-def _fused_knn_kernel(
-    q_ref,  # (q_tile, d) queries
-    c_ref,  # (c_tile, d) corpus tile
-    outd_ref,  # (1, q_tile, k) tile-local k smallest distances
-    outi_ref,  # (1, q_tile, k) their global corpus ids
-    *,
-    k: int,
-    q_tile: int,
-    c_tile: int,
-    m_corpus: int,  # real (unpadded) corpus rows; >= id means padding
-    exclude_self: bool,
-    exclude_zero: bool,
-    all_pairs: bool,
-    zero_eps: float,  # >0: absolute threshold; 0: relative (rtol · scale)
-    precision,
-):
-    qi = pl.program_id(0)
-    ci = pl.program_id(1)
+def _k_smallest_sweep(d, cand_ids, k):
+    """k-pass min extraction on the VPU: find each row's minimum, record it,
+    knock it out, repeat — the in-register replacement for qsort-per-insert.
+    ``d`` (q, c) masked distances, ``cand_ids`` (q, c) global candidate ids.
+    Returns ((q, k) dists, (q, k) ids), ascending; ties broken by the
+    leftmost column (the reference's first-encountered-wins scan order).
+    """
+    q, c = d.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (q, c), 1)
+    dists_out, ids_out = [], []
+    for _ in range(k):
+        row_min = jnp.min(d, axis=1, keepdims=True)  # (q, 1)
+        is_min = d == row_min
+        first_col = jnp.min(
+            jnp.where(is_min, col, _I32_MAX), axis=1, keepdims=True
+        )
+        hit = col == first_col
+        ids_j = jnp.max(jnp.where(hit, cand_ids, INVALID_ID), axis=1)
+        dists_out.append(row_min[:, 0])
+        ids_out.append(jnp.where(jnp.isinf(row_min[:, 0]), INVALID_ID, ids_j))
+        d = jnp.where(hit, jnp.inf, d)
+    return jnp.stack(dists_out, axis=1), jnp.stack(ids_out, axis=1)
 
-    q = q_ref[:]
-    c = c_ref[:]
+
+def _masked_tile_dists(
+    q, c, qi, ci, q_tile, c_tile, m_corpus, exclude_self, exclude_zero,
+    all_pairs, zero_eps, precision,
+):
+    """(q_tile, c_tile) masked squared-L2 distances + global candidate ids —
+    the kernel-side mirror of ops.distance.pairwise_sq_l2 + ops.topk.mask_tile."""
     q_sq = jnp.sum(q * q, axis=-1, keepdims=True)  # (q_tile, 1)
     c_sq = jnp.sum(c * c, axis=-1, keepdims=True).T  # (1, c_tile)
     # MXU: one matmul per tile; f32 accumulation
@@ -75,28 +84,85 @@ def _fused_knn_kernel(
         row = jax.lax.broadcasted_iota(jnp.int32, (q_tile, c_tile), 0)
         row_global = qi * q_tile + row  # query global ids (all-pairs mode)
         invalid = invalid | (col_global == row_global)
-    d = jnp.where(invalid, jnp.inf, d)
+    return jnp.where(invalid, jnp.inf, d), col_global
 
-    # k-pass min extraction on the VPU: find each row's minimum, record it,
-    # knock it out, repeat — the in-register replacement for qsort-per-insert
-    dists_out = []
-    ids_out = []
-    for _ in range(k):
-        row_min = jnp.min(d, axis=1, keepdims=True)  # (q_tile, 1)
-        # leftmost column attaining the min (stable tie-break, matching the
-        # reference's first-encountered-wins scan order)
-        is_min = d == row_min
-        first_col = jnp.min(
-            jnp.where(is_min, col, _I32_MAX), axis=1, keepdims=True
-        )
-        hit = col == first_col
-        ids_j = jnp.max(jnp.where(hit, col_global, INVALID_ID), axis=1)
-        dists_out.append(row_min[:, 0])
-        ids_out.append(jnp.where(jnp.isinf(row_min[:, 0]), INVALID_ID, ids_j))
-        d = jnp.where(hit, jnp.inf, d)
 
-    outd_ref[0] = jnp.stack(dists_out, axis=1)
-    outi_ref[0] = jnp.stack(ids_out, axis=1)
+def _fused_knn_kernel(
+    q_ref,  # (q_tile, d) queries
+    c_ref,  # (c_tile, d) corpus tile
+    outd_ref,  # (1, q_tile, k) tile-local k smallest distances
+    outi_ref,  # (1, q_tile, k) their global corpus ids
+    *,
+    k: int,
+    q_tile: int,
+    c_tile: int,
+    m_corpus: int,  # real (unpadded) corpus rows; >= id means padding
+    exclude_self: bool,
+    exclude_zero: bool,
+    all_pairs: bool,
+    zero_eps: float,  # >0: absolute threshold; 0: relative (rtol · scale)
+    precision,
+):
+    qi = pl.program_id(0)
+    ci = pl.program_id(1)
+    d, col_global = _masked_tile_dists(
+        q_ref[:], c_ref[:], qi, ci, q_tile, c_tile, m_corpus,
+        exclude_self, exclude_zero, all_pairs, zero_eps, precision,
+    )
+    outd_ref[0], outi_ref[0] = _k_smallest_sweep(d, col_global, k)
+
+
+def _fused_knn_sweep_kernel(
+    q_ref,  # (q_tile, d) queries
+    c_ref,  # (c_tile, d) corpus tile
+    outd_ref,  # (q_tile, k) FINAL k smallest distances (written last step)
+    outi_ref,  # (q_tile, k)
+    cd_ref,  # VMEM scratch: (q_tile, k) running carry distances
+    ci_ref,  # VMEM scratch: (q_tile, k) running carry ids
+    *,
+    k: int,
+    q_tile: int,
+    c_tile: int,
+    m_corpus: int,
+    exclude_self: bool,
+    exclude_zero: bool,
+    all_pairs: bool,
+    zero_eps: float,
+    precision,
+):
+    """Sweep variant: TPU grid cells execute SEQUENTIALLY, so for a fixed
+    query tile the corpus-tile loop (minor grid axis) carries the running
+    top-k in VMEM scratch. Only the final (q_tile, k) leaves the kernel —
+    no per-tile candidate lists in HBM and no XLA-side cross-tile merge."""
+    qi = pl.program_id(0)
+    ci = pl.program_id(1)
+    n_c = pl.num_programs(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        cd_ref[:] = jnp.full((q_tile, k), jnp.inf, jnp.float32)
+        ci_ref[:] = jnp.full((q_tile, k), INVALID_ID, jnp.int32)
+
+    d, col_global = _masked_tile_dists(
+        q_ref[:], c_ref[:], qi, ci, q_tile, c_tile, m_corpus,
+        exclude_self, exclude_zero, all_pairs, zero_eps, precision,
+    )
+    new_d, new_i = _k_smallest_sweep(d, col_global, k)
+    # merge carry + new: 2k candidates per row, k-pass extract again —
+    # always EXACT (cfg.topk_method's approx option applies only to the
+    # tiles variant's XLA-side merge). The carry ids are already unique vs
+    # this tile's (disjoint global ranges), so plain concat is a valid
+    # candidate multiset.
+    all_d = jnp.concatenate([cd_ref[:], new_d], axis=1)
+    all_i = jnp.concatenate([ci_ref[:], new_i], axis=1)
+    merged_d, merged_i = _k_smallest_sweep(all_d, all_i, k)
+    cd_ref[:] = merged_d
+    ci_ref[:] = merged_i
+
+    @pl.when(ci == n_c - 1)
+    def _emit():
+        outd_ref[:] = merged_d
+        outi_ref[:] = merged_i
 
 
 def fused_knn_tiles(
@@ -173,3 +239,82 @@ def fused_knn_tiles(
     outd = jnp.transpose(outd, (1, 0, 2)).reshape(Q, n_c * k)
     outi = jnp.transpose(outi, (1, 0, 2)).reshape(Q, n_c * k)
     return outd, outi
+
+
+def fused_knn_sweep(
+    queries: jax.Array,  # (Q, d), Q % q_tile == 0 (padded)
+    corpus: jax.Array,  # (C, d), C % c_tile == 0 (padded)
+    m_corpus: int,
+    k: int,
+    q_tile: int,
+    c_tile: int,
+    exclude_self: bool = True,
+    exclude_zero: bool = True,
+    all_pairs: bool = True,
+    zero_eps: float = 0.0,
+    precision=None,
+    interpret: bool | None = None,
+):
+    """Full fused all-kNN in one kernel: the corpus-tile sweep runs on the
+    minor grid axis with the running top-k in VMEM scratch (TPU grid cells
+    are sequential), emitting only the final (Q, k). No cross-tile merge
+    work outside the kernel.
+    """
+    Q, dim = queries.shape
+    C = corpus.shape[0]
+    if Q % q_tile or C % c_tile:
+        raise ValueError("caller must pad to tile multiples")
+    if k > c_tile:
+        # not a truncation hazard (later tiles would fill the inf-padded
+        # slots) but the k-pass unroll runs twice per tile here — keep the
+        # contract tight and let the backend route this corner to "tiles"
+        raise ValueError(f"k={k} exceeds corpus_tile={c_tile}")
+    n_q, n_c = Q // q_tile, C // c_tile
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(
+        _fused_knn_sweep_kernel,
+        k=k,
+        q_tile=q_tile,
+        c_tile=c_tile,
+        m_corpus=m_corpus,
+        exclude_self=exclude_self,
+        exclude_zero=exclude_zero,
+        all_pairs=all_pairs,
+        zero_eps=zero_eps,
+        precision=(
+            jax.lax.Precision.HIGHEST if precision is None else precision
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(n_q, n_c),
+        in_specs=[
+            pl.BlockSpec(
+                (q_tile, dim), lambda qi, ci: (qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (c_tile, dim), lambda qi, ci: (ci, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            # the same (qi, 0) block is revisited across the ci sweep and
+            # written once, at ci == n_c-1
+            pl.BlockSpec(
+                (q_tile, k), lambda qi, ci: (qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (q_tile, k), lambda qi, ci: (qi, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Q, k), jnp.float32),
+            jax.ShapeDtypeStruct((Q, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, k), jnp.float32),
+            pltpu.VMEM((q_tile, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries.astype(jnp.float32), corpus.astype(jnp.float32))

@@ -1,0 +1,116 @@
+"""A/B benchmark: blocking-schedule ring vs overlapped ring (BASELINE.md
+configs "blocking ring" / "non-blocking (overlapped) 8-way ring").
+
+The reference shipped the same A/B as two whole programs and the B side
+never actually overlapped (MPI_Wait before compute — SURVEY.md Q7). Here
+both schedules share one implementation (backends/ring.py, overlap flag);
+this harness times them on identical data/mesh and reports the ratio, which
+on real multi-chip hardware quantifies how much ICI transfer hides under
+the distance matmul. On a CPU-simulated mesh the ratio is meaningless
+(collectives are memcpys) — the harness still runs for mechanics testing.
+
+Usage:
+    python scripts/ring_ab.py --m 60000 --d 784 --k 10 [--devices N]
+                              [--dp G] [--reps 3] [--json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+# runnable as `python scripts/ring_ab.py` from anywhere
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--m", type=int, default=60000)
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--query-tile", type=int, default=1024)
+    ap.add_argument("--corpus-tile", type=int, default=4096)
+    ap.add_argument("--json", default=None, help="also write results here")
+    ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                    default="auto")
+    args = ap.parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_knn_tpu import KNNConfig, all_knn
+    from mpi_knn_tpu.parallel.mesh import make_mesh2d, make_ring_mesh
+    from mpi_knn_tpu.utils.report import recall_at_k
+    from mpi_knn_tpu.utils.timing import device_sync
+
+    n_dev = args.devices or len(jax.devices())
+    if args.dp > 1:
+        if n_dev % args.dp:
+            raise SystemExit(f"--dp {args.dp} must divide {n_dev}")
+        mesh = make_mesh2d(args.dp, n_dev // args.dp)
+    else:
+        mesh = make_ring_mesh(n_dev)
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((args.m, args.d)).astype(np.float32)
+    Xd = jax.device_put(jnp.asarray(X))
+    device_sync(Xd)
+
+    results = {}
+    ids = {}
+    for name, backend in (("blocking", "ring"), ("overlap", "ring-overlap")):
+        cfg = KNNConfig(
+            k=args.k,
+            backend=backend,
+            query_tile=args.query_tile,
+            corpus_tile=args.corpus_tile,
+        )
+        res = all_knn(Xd, config=cfg, mesh=mesh)  # compile + warm
+        device_sync(res.dists)
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            res = all_knn(Xd, config=cfg, mesh=mesh)
+            device_sync(res.dists, res.ids)
+            times.append(time.perf_counter() - t0)
+        results[name] = min(times)
+        # sample neighbor ids for the A==B sanity check (full fetch would be
+        # slow over tunneled transports)
+        sample = jnp.asarray(
+            np.linspace(0, args.m - 1, num=min(128, args.m), dtype=np.int64)
+        )
+        ids[name] = np.asarray(jax.device_get(res.ids[sample]))
+
+    same = recall_at_k(ids["overlap"], ids["blocking"])
+    out = {
+        "m": args.m,
+        "d": args.d,
+        "k": args.k,
+        "mesh": list(np.asarray(mesh.devices).shape),
+        "platform": jax.default_backend(),
+        "blocking_s": round(results["blocking"], 4),
+        "overlap_s": round(results["overlap"], 4),
+        "speedup_overlap": round(results["blocking"] / results["overlap"], 3),
+        "results_agree": round(float(same), 5),
+    }
+    print(json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

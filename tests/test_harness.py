@@ -1,6 +1,7 @@
 """Harness: timing, report, checkpoint/resume, CLI."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -241,3 +242,17 @@ def test_cli_entrypoint_subprocess():
         capture_output=True, text=True, cwd="/root/repo", timeout=300,
     )
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_ring_ab_script():
+    """scripts/ring_ab.py runs both ring schedules and reports agreement."""
+    r = subprocess.run(
+        [sys.executable, "scripts/ring_ab.py", "--m", "256", "--d", "16",
+         "--k", "3", "--platform", "cpu", "--reps", "1"],
+        capture_output=True, text=True, cwd="/root/repo", timeout=300,
+        env=os.environ,  # conftest already appended the 8-device XLA flag
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["results_agree"] == 1.0
+    assert out["blocking_s"] > 0 and out["overlap_s"] > 0

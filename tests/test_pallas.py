@@ -1,6 +1,8 @@
-"""Fused Pallas kernel vs the serial backend / numpy oracle. On CPU the
-kernel body runs in interpreter mode — same code path that compiles via
-Mosaic on TPU."""
+"""Fused Pallas kernels vs the serial backend / numpy oracle. On CPU the
+kernel bodies run in interpreter mode — same code paths that compile via
+Mosaic on TPU. Both kernel shapes are covered: "tiles" (per-tile local
+top-k + XLA cross-tile merge) and "sweep" (carry in VMEM scratch across the
+sequential corpus-tile grid axis, final (Q, k) only)."""
 
 import numpy as np
 import pytest
@@ -13,9 +15,15 @@ def _blobs(rng, m=256, d=32):
     return (rng.standard_normal((m, d)) * 3).astype(np.float32)
 
 
-def test_pallas_matches_oracle_all_pairs(rng):
+@pytest.fixture(params=["tiles", "sweep"])
+def variant(request):
+    return request.param
+
+
+def test_pallas_matches_oracle_all_pairs(rng, variant):
     X = _blobs(rng, m=256, d=32)
-    got = all_knn(X, k=8, backend="pallas", query_tile=64, corpus_tile=64)
+    got = all_knn(X, k=8, backend="pallas", pallas_variant=variant,
+                  query_tile=64, corpus_tile=64)
     want_d, want_i = oracle_all_knn(X, k=8)
     np.testing.assert_allclose(
         np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3
@@ -24,29 +32,33 @@ def test_pallas_matches_oracle_all_pairs(rng):
         assert set(np.asarray(got.ids)[r]) == set(want_i[r]), f"row {r}"
 
 
-def test_pallas_matches_serial_query_mode(rng):
+def test_pallas_matches_serial_query_mode(rng, variant):
     X = _blobs(rng, m=128, d=16)
     Q = _blobs(rng, m=64, d=16)
-    pal = all_knn(X, queries=Q, k=5, backend="pallas", query_tile=32, corpus_tile=64)
-    ser = all_knn(X, queries=Q, k=5, backend="serial", query_tile=32, corpus_tile=64)
+    pal = all_knn(X, queries=Q, k=5, backend="pallas", pallas_variant=variant,
+                  query_tile=32, corpus_tile=64)
+    ser = all_knn(X, queries=Q, k=5, backend="serial",
+                  query_tile=32, corpus_tile=64)
     np.testing.assert_allclose(
         np.asarray(pal.dists), np.asarray(ser.dists), rtol=1e-4, atol=1e-4
     )
     np.testing.assert_array_equal(np.asarray(pal.ids), np.asarray(ser.ids))
 
 
-def test_pallas_non_divisible_shapes(rng):
+def test_pallas_non_divisible_shapes(rng, variant):
     X = _blobs(rng, m=157, d=24)
-    got = all_knn(X, k=6, backend="pallas", query_tile=32, corpus_tile=64)
+    got = all_knn(X, k=6, backend="pallas", pallas_variant=variant,
+                  query_tile=32, corpus_tile=64)
     want_d, want_i = oracle_all_knn(X, k=6)
     assert got.ids.shape == (157, 6)
     np.testing.assert_allclose(np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3)
 
 
-def test_pallas_duplicate_exclusion(rng):
+def test_pallas_duplicate_exclusion(rng, variant):
     X = (rng.random((64, 128)) * 255).astype(np.float32)
     X[5] = X[60]
-    got = all_knn(X, k=4, backend="pallas", query_tile=32, corpus_tile=64)
+    got = all_knn(X, k=4, backend="pallas", pallas_variant=variant,
+                  query_tile=32, corpus_tile=64)
     ids = np.asarray(got.ids)
     assert 60 not in ids[5] and 5 not in ids[60]
 
@@ -57,10 +69,42 @@ def test_pallas_rejects_cosine(rng):
         all_knn(X, k=3, backend="pallas", metric="cosine")
 
 
-def test_pallas_k_exceeding_tile_is_merged(rng):
-    """k > per-tile k: the tile emits min(k, c_tile) and the merge tops up
-    across tiles; with 2+ tiles the final k can exceed one tile's yield."""
+def test_pallas_rejects_unknown_variant(rng):
+    X = _blobs(rng, m=64, d=8)
+    with pytest.raises(ValueError, match="pallas_variant"):
+        all_knn(X, k=3, backend="pallas", pallas_variant="nope")
+
+
+def test_pallas_k_exceeding_tile_is_merged(rng, variant):
+    """k > per-tile k: the kernel emits min(k, c_tile) per tile; "tiles"
+    tops up across tiles in the XLA merge, "sweep" in the scratch carry —
+    with 2+ tiles the final k can exceed one tile's yield. ("sweep" carries
+    only c_tile candidates per step, so its floor is min(k, c_tile)-per-
+    round completeness — same merge property the ring relies on.)"""
     X = _blobs(rng, m=96, d=8)
-    got = all_knn(X, k=40, backend="pallas", query_tile=32, corpus_tile=48)
+    got = all_knn(X, k=40, backend="pallas", pallas_variant=variant,
+                  query_tile=32, corpus_tile=48)
     want_d, want_i = oracle_all_knn(X, k=40)
     np.testing.assert_allclose(np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3)
+
+
+def test_sweep_single_tile(rng):
+    """n_c == 1: init, merge, and emit all happen in the same grid cell."""
+    X = _blobs(rng, m=48, d=8)
+    got = all_knn(X, k=5, backend="pallas", pallas_variant="sweep",
+                  query_tile=16, corpus_tile=64)
+    ser = all_knn(X, k=5, backend="serial", query_tile=16, corpus_tile=64)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(ser.ids))
+
+
+def test_sweep_k_exceeding_carry_falls_back(rng):
+    """k > c_tile cannot be represented by the sweep's scratch carry; the
+    backend must fall back to the tiles variant and stay COMPLETE (a
+    truncated top-k would silently drop true neighbors)."""
+    X = _blobs(rng, m=300, d=8)
+    got = all_knn(X, k=150, backend="pallas", pallas_variant="sweep",
+                  query_tile=32, corpus_tile=128)
+    want_d, want_i = oracle_all_knn(X, k=150)
+    np.testing.assert_allclose(
+        np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3
+    )
