@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16", "float64"])
     k.add_argument("--topk-method", choices=["exact", "approx"], default="exact")
+    k.add_argument("--pallas-variant", choices=["tiles", "sweep"],
+                   default="tiles",
+                   help="pallas backend kernel shape: per-tile top-k + XLA "
+                   "merge, or VMEM-scratch sweep (see backends/pallas)")
     k.add_argument("--include-zero-dist", action="store_true",
                    help="keep zero-distance (duplicate) neighbors — the "
                    "reference excludes them (knn-serial.c:86)")
@@ -81,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("--queries", default=None,
                    help=".mat/.npy file of query points (query mode)")
     o.add_argument("--report", default=None, help="write JSON report here")
+    o.add_argument("--save-neighbors", default=None, metavar="PATH.npz",
+                   help="write the neighbor lists (dists + 0-based ids, and "
+                   "predictions when voting ran) as NPZ — the reference "
+                   "only ever printed to stdout (knn-serial.c:130)")
     o.add_argument("--one-based-ids", action="store_true",
                    help="print 1-based neighbor ids (reference parity)")
     o.add_argument("--profile", default=None, metavar="DIR",
@@ -238,6 +246,7 @@ def main(argv=None) -> int:
         corpus_tile=args.corpus_tile,
         dtype=args.dtype,
         topk_method=args.topk_method,
+        pallas_variant=args.pallas_variant,
         exclude_zero=not args.include_zero_dist,
         exclude_self=not args.include_self,
         num_devices=args.devices,
@@ -401,6 +410,22 @@ def main(argv=None) -> int:
             ids = _to_host(result.one_based())
             print("neighbor ids (1-based, first 5 queries):")
             print(ids[:5])
+
+    if args.save_neighbors:
+        out = {
+            "dists": _to_host(result.dists),
+            "ids": _to_host(result.ids),
+        }
+        if cls is not None:
+            out["predictions"] = _to_host(cls.predictions)
+        # np.savez appends .npz itself when absent; normalize so the
+        # printed path names the file that actually exists
+        nn_path = args.save_neighbors
+        if not nn_path.endswith(".npz"):
+            nn_path += ".npz"
+        np.savez(nn_path, **out)
+        if not args.quiet:
+            print(f"neighbors written to {nn_path}")
 
     if args.report:
         report.save(args.report)

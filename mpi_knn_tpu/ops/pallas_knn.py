@@ -138,31 +138,37 @@ def _fused_knn_sweep_kernel(
     ci = pl.program_id(1)
     n_c = pl.num_programs(1)
 
-    @pl.when(ci == 0)
-    def _init():
-        cd_ref[:] = jnp.full((q_tile, k), jnp.inf, jnp.float32)
-        ci_ref[:] = jnp.full((q_tile, k), INVALID_ID, jnp.int32)
-
     d, col_global = _masked_tile_dists(
         q_ref[:], c_ref[:], qi, ci, q_tile, c_tile, m_corpus,
         exclude_self, exclude_zero, all_pairs, zero_eps, precision,
     )
     new_d, new_i = _k_smallest_sweep(d, col_global, k)
-    # merge carry + new: 2k candidates per row, k-pass extract again —
-    # always EXACT (cfg.topk_method's approx option applies only to the
-    # tiles variant's XLA-side merge). The carry ids are already unique vs
-    # this tile's (disjoint global ranges), so plain concat is a valid
-    # candidate multiset.
-    all_d = jnp.concatenate([cd_ref[:], new_d], axis=1)
-    all_i = jnp.concatenate([ci_ref[:], new_i], axis=1)
-    merged_d, merged_i = _k_smallest_sweep(all_d, all_i, k)
-    cd_ref[:] = merged_d
-    ci_ref[:] = merged_i
+
+    @pl.when(ci == 0)
+    def _first():
+        # first tile: the carry IS this tile's top-k (merging against an
+        # all-inf init would just burn k extra extraction passes)
+        cd_ref[:] = new_d
+        ci_ref[:] = new_i
+
+    @pl.when(ci > 0)
+    def _merge():
+        # merge carry + new: 2k candidates per row, k-pass extract again —
+        # always EXACT (cfg.topk_method's approx option applies only to the
+        # tiles variant's XLA-side merge). Carry ids come from earlier
+        # (lower-id) tiles, disjoint from this tile's, so plain concat is a
+        # valid candidate multiset and carry-first preserves the
+        # first-encountered-wins tie order.
+        all_d = jnp.concatenate([cd_ref[:], new_d], axis=1)
+        all_i = jnp.concatenate([ci_ref[:], new_i], axis=1)
+        merged_d, merged_i = _k_smallest_sweep(all_d, all_i, k)
+        cd_ref[:] = merged_d
+        ci_ref[:] = merged_i
 
     @pl.when(ci == n_c - 1)
     def _emit():
-        outd_ref[:] = merged_d
-        outi_ref[:] = merged_i
+        outd_ref[:] = cd_ref[:]
+        outi_ref[:] = ci_ref[:]
 
 
 def fused_knn_tiles(

@@ -82,20 +82,34 @@ def save_checkpoint(ckpt_dir, state: KNNCheckpoint):
 
 
 def load_checkpoint(ckpt_dir, expect_fingerprint: str) -> Optional[KNNCheckpoint]:
-    """Returns the saved state, or None if absent/mismatched."""
+    """Returns the saved state, or None if absent/mismatched/corrupt.
+
+    Corruption (torn write outside the atomic rename path, disk fault,
+    truncation) degrades to a clean restart — the alternative is a resumable
+    run that crashes on the very artifact meant to save it."""
     path = Path(ckpt_dir) / _STATE_FILE
     if not path.exists():
         return None
-    with np.load(path) as z:
-        fp = z["fingerprint"].tobytes().decode()
-        if fp != expect_fingerprint:
-            return None
-        return KNNCheckpoint(
-            carry_d=z["carry_d"],
-            carry_i=z["carry_i"],
-            tiles_done=int(z["tiles_done"]),
-            fingerprint=fp,
+    try:
+        with np.load(path) as z:
+            fp = z["fingerprint"].tobytes().decode()
+            if fp != expect_fingerprint:
+                return None
+            return KNNCheckpoint(
+                carry_d=z["carry_d"],
+                carry_i=z["carry_i"],
+                tiles_done=int(z["tiles_done"]),
+                fingerprint=fp,
+            )
+    except Exception as e:  # any unreadable state -> clean restart
+        import logging
+
+        logging.getLogger("mpi_knn_tpu").warning(
+            "ignoring unreadable checkpoint %s (%s); restarting from zero",
+            path,
+            e,
         )
+        return None
 
 
 def clear_checkpoint(ckpt_dir):

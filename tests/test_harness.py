@@ -256,3 +256,25 @@ def test_ring_ab_script():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["results_agree"] == 1.0
     assert out["blocking_s"] > 0 and out["overlap_s"] > 0
+
+
+def test_save_neighbors_and_corrupt_checkpoint(tmp_path):
+    """--save-neighbors writes NPZ; a corrupt checkpoint file degrades to a
+    clean restart instead of crashing the resumable run."""
+    out = tmp_path / "nn.npz"
+    rc = cli_main(
+        ["--data", "synthetic:96x8c4", "--k", "3", "--num-classes", "4",
+         "--backend", "serial", "--platform", "cpu", "-q",
+         "--save-neighbors", str(out)]
+    )
+    assert rc == 0
+    z = np.load(out)
+    assert z["ids"].shape == (96, 3) and z["predictions"].shape == (96,)
+
+    # corrupt checkpoint -> load returns None (restart), no exception
+    from mpi_knn_tpu.utils.checkpoint import load_checkpoint
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "knn_state.npz").write_bytes(b"not a zip at all")
+    assert load_checkpoint(ck, "whatever") is None
