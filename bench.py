@@ -92,6 +92,7 @@ def main() -> int:
         # many small merge steps (measured on v5e)
         corpus_tile=int(os.environ.get("BENCH_CT", str(1 << 20))),
         topk_method=os.environ.get("BENCH_TOPK", "exact"),
+        pallas_variant=os.environ.get("BENCH_PALLAS_VARIANT", "tiles"),
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
         matmul_precision=os.environ.get("BENCH_PRECISION") or None,

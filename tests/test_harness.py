@@ -278,3 +278,18 @@ def test_save_neighbors_and_corrupt_checkpoint(tmp_path):
     ck.mkdir()
     (ck / "knn_state.npz").write_bytes(b"not a zip at all")
     assert load_checkpoint(ck, "whatever") is None
+
+
+def test_cli_profile_writes_trace(tmp_path):
+    """--profile writes a jax.profiler trace directory (SURVEY.md §6
+    tracing row — the XProf-compatible replacement for gettimeofday)."""
+    prof = tmp_path / "trace"
+    rc = cli_main(
+        ["--data", "synthetic:64x8c4", "--k", "3", "--num-classes", "4",
+         "--backend", "serial", "--platform", "cpu", "-q",
+         "--profile", str(prof)]
+    )
+    assert rc == 0
+    # the profiler lays out plugins/profile/<run>/; existence of any file
+    # under the dir is the contract
+    assert any(prof.rglob("*")), "no trace files written"
