@@ -23,6 +23,7 @@ the north star at equal silicon.
 
 Environment knobs: BENCH_M (default 60000), BENCH_BACKEND (serial|pallas),
 BENCH_REPS, BENCH_QT/BENCH_CT (tiles), BENCH_TOPK (exact|approx),
+BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_WATCHDOG_S (0 disables),
 TKNN_MNIST (real data path; synthetic surrogate otherwise).
 
 The recall gate is FIXED at 0.999 regardless of knobs — it is the north
