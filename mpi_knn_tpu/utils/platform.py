@@ -1,0 +1,67 @@
+"""Force the JAX platform in axon-proof fashion.
+
+The tunneled axon TPU plugin ignores the ``JAX_PLATFORMS`` environment
+variable: a process that merely exports it still initializes the TPU
+tunnel (and hangs if the device is wedged — the round-1 MULTICHIP gate
+failure). The only reliable sequence is to set the env vars for any
+child processes AND apply ``jax.config.update("jax_platforms", ...)``
+before first device access. This is the one shared implementation for
+the four places that need it (tests, the driver entry point, bench,
+the CLI).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def force_platform(name: str, n_devices: int | None = None) -> None:
+    """Pin ``jax_platforms`` to ``name``; optionally force ``n_devices``
+    virtual host devices (CPU platform only).
+
+    Must run before the first JAX device access in this process. If jax's
+    backend is already initialized the config update cannot take effect —
+    that is reported loudly rather than silently proceeding on the wrong
+    platform.
+    """
+    os.environ["JAX_PLATFORMS"] = name
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        # replace any pre-existing count: a stale smaller value would
+        # starve the mesh this process is about to build
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\S+", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", name)
+
+    if _backend_already_initialized():
+        devs = jax.devices()
+        plats = {d.platform for d in devs}
+        if plats != {name} or (
+            n_devices is not None and len(devs) < n_devices
+        ):
+            raise RuntimeError(
+                f"force_platform({name!r}, n_devices={n_devices}) called "
+                f"after JAX initialized {len(devs)} {sorted(plats)} "
+                "device(s); it must run before first device access"
+            )
+
+
+def _backend_already_initialized() -> bool:
+    """True iff some jax backend has been brought up in this process
+    (device queries would no longer honor a config change)."""
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None:
+        return False
+    probe = getattr(xb, "backends_are_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    return bool(getattr(xb, "_backends", None))

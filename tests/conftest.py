@@ -2,22 +2,22 @@
 ring runs without TPU hardware (SURVEY.md §4 "Distributed-without-a-cluster"),
 and enable x64 for the float64 debug/oracle paths (SURVEY.md §5 Q10).
 
-Must run before jax is imported anywhere in the test session.
+Invariant: force_platform must run before the first JAX *device access*
+(backend creation), not before `import jax` — importing mpi_knn_tpu below
+already imports jax, which is fine because XLA_FLAGS and jax_platforms are
+both read at backend creation time. force_platform raises if a backend
+already exists. Never add device access (jax.devices(), array creation) at
+module import time anywhere in the package.
 """
 
-import os
+from mpi_knn_tpu.utils.platform import force_platform
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# the axon TPU plugin ignores JAX_PLATFORMS; the shared helper applies the
+# config knob that actually wins
+force_platform("cpu", n_devices=8)
 
 import jax  # noqa: E402
 
-# the axon TPU plugin ignores JAX_PLATFORMS; the config knob wins
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
