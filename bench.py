@@ -12,7 +12,8 @@ phase — ``/root/reference/knn-serial.c:70,94-98`` — not I/O or voting):
   corpus and synchronizes with ``device_sync`` (a 1-element fetch —
   ``block_until_ready`` alone can return at dispatch time on tunneled
   device transports and would under-report);
-- value = best rep wall-clock of the all-kNN phase;
+- value = MEDIAN rep wall-clock of the all-kNN phase (all reps plus the
+  min are reported on stderr — min alone flatters a noisy transport);
 - recall@10 is checked against a float64 host oracle on a 256-query sample
   (computed in matmul form, chunk-free at this sample size); a recall miss
   (<0.999) zeroes vs_baseline rather than reporting a fast-but-wrong number.
@@ -24,7 +25,9 @@ the north star at equal silicon.
 Environment knobs: BENCH_M (default 60000), BENCH_BACKEND (serial|pallas),
 BENCH_REPS, BENCH_QT/BENCH_CT (tiles), BENCH_TOPK (exact|approx),
 BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_WATCHDOG_S (0 disables),
-TKNN_MNIST (real data path; synthetic surrogate otherwise).
+BENCH_PLATFORM (forces jax_platforms via the config API — JAX_PLATFORMS
+alone is ignored by the axon TPU plugin), TKNN_MNIST (real data path;
+synthetic surrogate otherwise).
 
 The recall gate is FIXED at 0.999 regardless of knobs — it is the north
 star's acceptance bar, not a tunable. Setting BENCH_RT below it tunes
@@ -71,6 +74,13 @@ def oracle_topk(X: np.ndarray, sample: np.ndarray, k: int) -> np.ndarray:
 
 
 def main() -> int:
+    if os.environ.get("BENCH_PLATFORM"):
+        # the axon TPU plugin ignores JAX_PLATFORMS; the shared helper is
+        # the only reliable way to keep a CPU smoke run off the tunnel
+        from mpi_knn_tpu.utils.platform import force_platform
+
+        force_platform(os.environ["BENCH_PLATFORM"])
+
     import jax
     import jax.numpy as jnp
 
@@ -89,9 +99,12 @@ def main() -> int:
         k=k,
         backend=backend,
         query_tile=int(os.environ.get("BENCH_QT", "4096")),
-        # whole corpus per query tile: one matmul + one top-k per tile beats
-        # many small merge steps (measured on v5e)
-        corpus_tile=int(os.environ.get("BENCH_CT", str(1 << 20))),
+        # corpus tile capped at 8192: exact lax.top_k over very wide
+        # (~60k-col) concats is the known device-wedge mode on the tunneled
+        # transport (round-1 watchdog fired on the whole-corpus default).
+        # A surviving 8k-tile run beats a wedged "faster" config every time;
+        # the aggressive whole-corpus tiling stays reachable via BENCH_CT.
+        corpus_tile=int(os.environ.get("BENCH_CT", "8192")),
         topk_method=os.environ.get("BENCH_TOPK", "exact"),
         pallas_variant=os.environ.get("BENCH_PALLAS_VARIANT", "tiles"),
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
@@ -114,7 +127,9 @@ def main() -> int:
         result = all_knn(Xd, config=cfg)
         device_sync(result.dists, result.ids)
         times.append(time.perf_counter() - t0)
-    value = min(times)
+    # median is the headline (VERDICT r1 #9): honest under transport noise;
+    # min stays visible on stderr for best-case comparisons
+    value = float(np.median(times))
 
     sample = np.linspace(0, m - 1, num=min(256, m), dtype=np.int64)
     want = oracle_topk(X, sample, k)
@@ -142,6 +157,7 @@ def main() -> int:
                 "shape": list(X.shape),
                 "recall_at_k_vs_oracle": round(float(recall), 5),
                 "times": [round(t, 4) for t in times],
+                "min_seconds": round(min(times), 4),
                 "chips": n_chips,
                 "platform": jax.default_backend(),
                 "target_seconds_at_this_chip_count": target_here,
