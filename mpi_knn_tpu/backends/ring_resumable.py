@@ -141,8 +141,14 @@ def all_knn_ring_resumable(
         fingerprint(corpus, queries, cfg)
         + f":ring{ring_n}x{dp}:{int(overlap)}"
     )
-
     if cfg.center and cfg.metric == "l2":
+        # centering accumulates the corpus mean in f32 on the device path
+        # but f64 on the host path (center_for_l2), so carries from the two
+        # residencies differ by fp noise near ties. Fold the residency into
+        # the run identity so a cross-residency resume restarts cleanly
+        # instead of silently merging mixed-centering carries (ADVICE r1).
+        fp += f":ctr-{'dev' if isinstance(corpus, jax.Array) else 'host'}"
+
         from mpi_knn_tpu.ops.distance import center_for_l2
 
         corpus, queries = center_for_l2(corpus, queries, all_pairs)
@@ -160,11 +166,47 @@ def all_knn_ring_resumable(
     carry_d, carry_i = init_topk(q_pad, cfg.k, dtype=acc)
 
     if checkpoint_dir is not None:
-        state = load_checkpoint(checkpoint_dir, fp)
-        if state is not None:
-            start_round = state.tiles_done  # field reused as rounds_done
-            carry_d = jnp.asarray(state.carry_d, dtype=acc)
-            carry_i = jnp.asarray(state.carry_i)
+        if jax.process_count() > 1:
+            # Multi-host: only process 0 writes checkpoints, so only process
+            # 0's read DECIDES. Letting every process trust its own local
+            # read (non-shared dir, torn file -> corruption-tolerant None)
+            # could start processes at different rounds — mismatched
+            # collectives hang or corrupt instead of erroring. Broadcast
+            # (rounds_done, carry) from process 0 so all hosts agree.
+            from jax.experimental import multihost_utils
+
+            state = (
+                load_checkpoint(checkpoint_dir, fp)
+                if jax.process_index() == 0
+                else None
+            )
+            done0 = np.int32(0 if state is None else state.tiles_done)
+            start_round = int(multihost_utils.broadcast_one_to_all(done0))
+            if start_round > 0:
+                shape = (q_pad, cfg.k)
+                cd = (
+                    np.asarray(state.carry_d, dtype=acc)
+                    if state is not None
+                    else np.zeros(shape, dtype=acc)
+                )
+                ci = (
+                    np.asarray(state.carry_i, dtype=np.int32)
+                    if state is not None
+                    else np.zeros(shape, dtype=np.int32)
+                )
+                carry_d = jnp.asarray(
+                    multihost_utils.broadcast_one_to_all(cd), dtype=acc
+                )
+                carry_i = jnp.asarray(
+                    multihost_utils.broadcast_one_to_all(ci)
+                )
+        else:
+            state = load_checkpoint(checkpoint_dir, fp)
+            if state is not None:
+                start_round = state.tiles_done  # field reused as rounds_done
+                carry_d = jnp.asarray(state.carry_d, dtype=acc)
+                carry_i = jnp.asarray(state.carry_i)
+        if start_round:
             log.info("resuming ring at round %d/%d from %s",
                      start_round, ring_n, checkpoint_dir)
 

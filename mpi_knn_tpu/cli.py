@@ -184,12 +184,15 @@ def _to_host(a) -> np.ndarray:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.save_every is not None and args.save_every <= 0:
+        parser.error("--save-every must be a positive round count")
 
     if args.platform != "auto":
-        import jax
+        from mpi_knn_tpu.utils.platform import force_platform
 
-        jax.config.update("jax_platforms", args.platform)
+        force_platform(args.platform)
 
     from mpi_knn_tpu.utils.logs import log, setup_logging
 
@@ -316,7 +319,8 @@ def main(argv=None) -> int:
                         mesh=mesh,
                         overlap=(resolved == "ring-overlap"),
                         checkpoint_dir=args.checkpoint_dir,
-                        save_every=args.save_every or 1,
+                        save_every=(1 if args.save_every is None
+                                    else args.save_every),
                     )
                 else:
                     from mpi_knn_tpu.backends.resumable import (
@@ -326,7 +330,8 @@ def main(argv=None) -> int:
                     d, i = all_knn_resumable(
                         X, q_arr, q_ids, cfg,
                         checkpoint_dir=args.checkpoint_dir,
-                        save_every=args.save_every or 8,
+                        save_every=(8 if args.save_every is None
+                                    else args.save_every),
                     )
                 result = KNNResult(dists=d, ids=i)
             else:

@@ -29,6 +29,12 @@ def center_for_l2(corpus, queries, all_pairs: bool):
     for api.all_knn and both resumable drivers: device-resident inputs are
     centered on device (no host bounce; f64 stays f64 when x64 is on), host
     inputs keep the f64 mean for the debug mode.
+
+    The two paths accumulate the mean at different precisions, so centered
+    values for the SAME data differ by fp noise across residencies —
+    bit-identical checkpoint resume holds per-residency only, and
+    ring_resumable folds the residency into the run fingerprint so a
+    cross-residency resume restarts rather than merging mixed carries.
     """
     if isinstance(corpus, jax.Array):
         acc = jnp.float64 if corpus.dtype == jnp.float64 else jnp.float32

@@ -32,7 +32,10 @@ def _array_signature(arr) -> bytes:
     arrays (device-side reshape+slice, so only the small sample crosses to
     host), so the fingerprint is residency-independent: a run checkpointed
     with a numpy corpus resumes when re-invoked with the identical corpus
-    already on device, and vice versa."""
+    already on device, and vice versa. Exception: centered ring runs fold
+    the residency back in (ring_resumable appends a :ctr-dev/:ctr-host
+    suffix) because center_for_l2 accumulates the mean at residency-
+    dependent precision — do not 'simplify' that suffix away."""
     shape, dtype = tuple(arr.shape), str(arr.dtype)
     n = 1
     for dim in shape:

@@ -173,6 +173,21 @@ def test_cli_checkpoint_flag(tmp_path, capsys):
     assert (tmp_path / "ck" / "knn_state.npz").exists()
 
 
+def test_cli_save_every_zero_rejected(capsys):
+    """--save-every 0 must be an argparse error, not silently replaced by
+    the default cadence (ADVICE r1)."""
+    import pytest
+
+    with pytest.raises(SystemExit) as e:
+        cli_main(
+            ["--data", "synthetic:96x8c4", "--k", "3", "--num-classes", "4",
+             "--backend", "serial", "--checkpoint-dir", "/tmp/never-used",
+             "--save-every", "0"]
+        )
+    assert e.value.code == 2
+    assert "--save-every" in capsys.readouterr().err
+
+
 def test_cli_svd_with_queries_projects_both(tmp_path, capsys):
     """Regression: --svd must project the queries into the same subspace as
     the corpus, not leave them at full dimensionality."""

@@ -128,6 +128,31 @@ def test_fingerprint_residency_independent(rng):
     assert fingerprint(X2, Q, cfg) != host
 
 
+def test_centered_checkpoint_rejects_cross_residency_resume(rng, tmp_path):
+    """With cfg.center, the corpus mean accumulates at different precisions
+    on the host vs device paths, so a carry checkpointed from a numpy corpus
+    must NOT silently merge into a device-resident rerun (ADVICE r1) — the
+    fingerprint folds the residency in and forces a clean restart."""
+    import jax
+    import jax.numpy as jnp
+
+    X = _data(rng, m=64)
+    cfg = KNNConfig(k=3, query_tile=4, corpus_tile=8, center=True)
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck, stop_after_rounds=2
+    )
+    rounds = []
+    Xd = jax.device_put(jnp.asarray(X))
+    d, i = all_knn_ring_resumable(
+        Xd, Xd, _ids(len(X)), cfg, checkpoint_dir=ck,
+        progress_cb=lambda r, t: rounds.append(r),
+    )
+    assert rounds[0] == 1  # restarted from round 0, not resumed
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
 def test_resumable_rejects_3d_mesh(rng):
     import jax
     import numpy as np_
