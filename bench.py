@@ -87,6 +87,11 @@ def main() -> int:
     m = int(os.environ.get("BENCH_M", "60000"))
     k = int(os.environ.get("BENCH_K", "10"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
+    if reps < 1:
+        # median([]) would silently emit NaN as the headline value
+        print(json.dumps({"error": "BENCH_REPS must be >= 1"}),
+              file=sys.stderr)
+        return 2
     backend = os.environ.get("BENCH_BACKEND", "serial")
 
     from mpi_knn_tpu import KNNConfig, all_knn
