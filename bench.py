@@ -111,6 +111,8 @@ def main() -> int:
         # the aggressive whole-corpus tiling stays reachable via BENCH_CT.
         corpus_tile=int(os.environ.get("BENCH_CT", "8192")),
         topk_method=os.environ.get("BENCH_TOPK", "exact"),
+        merge_schedule=os.environ.get("BENCH_SCHEDULE", "twolevel"),
+        topk_block=int(os.environ.get("BENCH_BLOCK", "128")),
         pallas_variant=os.environ.get("BENCH_PALLAS_VARIANT", "tiles"),
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
@@ -168,6 +170,7 @@ def main() -> int:
                 "target_seconds_at_this_chip_count": target_here,
                 "recall_gate": RECALL_GATE,
                 "topk_method": cfg.topk_method,
+                "merge_schedule": cfg.merge_schedule,
                 "tiles": [cfg.query_tile, cfg.corpus_tile],
             }
         ),

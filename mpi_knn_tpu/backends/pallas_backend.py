@@ -80,7 +80,8 @@ def _pallas_all_knn(
     )
     # cross-tile merge: k survivors per corpus tile -> final k
     return smallest_k(
-        outd, outi, cfg.k, method=cfg.topk_method, recall_target=cfg.recall_target
+        outd, outi, cfg.k, method=cfg.topk_method,
+        recall_target=cfg.recall_target, block=cfg.topk_block,
     )
 
 

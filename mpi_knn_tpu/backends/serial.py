@@ -26,12 +26,53 @@ import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import pairwise_dist, sq_norms
-from mpi_knn_tpu.ops.topk import init_topk, mask_tile, smallest_k
+from mpi_knn_tpu.ops.topk import (
+    cascade_smallest_k,
+    init_topk,
+    mask_tile,
+    smallest_k,
+)
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
     pad_rows_any,
     pad_to_multiple,
 )
+
+
+def masked_dist_tile(
+    q_x: jax.Array,
+    q_ids: jax.Array,
+    q_sq: jax.Array | None,
+    blk: jax.Array,
+    blk_ids: jax.Array,
+    blk_sq: jax.Array | None,
+    cfg: KNNConfig,
+) -> jax.Array:
+    """(q_tile × c_tile) masked distances: metric kernel → padding/self/zero
+    exclusion masks. The compute half shared by both merge schedules and the
+    ring backends."""
+    d = pairwise_dist(
+        q_x,
+        blk,
+        metric=cfg.metric,
+        x_sq=q_sq,
+        y_sq=blk_sq,
+        precision=cfg.matmul_precision,
+    )
+    if cfg.metric == "l2" and q_sq is not None and blk_sq is not None:
+        pair_scale = q_sq[:, None] + blk_sq[None, :]
+    else:
+        # cosine distances live in [0, 2]; constant scale for the zero test
+        pair_scale = jnp.asarray(2.0, dtype=d.dtype)
+    return mask_tile(
+        d,
+        blk_ids,
+        query_ids=q_ids if cfg.exclude_self else None,
+        exclude_self=cfg.exclude_self,
+        exclude_zero=cfg.exclude_zero,
+        zero_eps=cfg.zero_eps,
+        scale=pair_scale,
+    )
 
 
 def knn_tile_step(
@@ -46,29 +87,9 @@ def knn_tile_step(
     cfg: KNNConfig,
 ):
     """One fused (query_tile × corpus_tile) step: distances → masks → merged
-    top-k. Shared by every backend."""
-    d = pairwise_dist(
-        q_x,
-        blk,
-        metric=cfg.metric,
-        x_sq=q_sq,
-        y_sq=blk_sq,
-        precision=cfg.matmul_precision,
-    )
-    if cfg.metric == "l2" and q_sq is not None and blk_sq is not None:
-        pair_scale = q_sq[:, None] + blk_sq[None, :]
-    else:
-        # cosine distances live in [0, 2]; constant scale for the zero test
-        pair_scale = jnp.asarray(2.0, dtype=d.dtype)
-    d = mask_tile(
-        d,
-        blk_ids,
-        query_ids=q_ids if cfg.exclude_self else None,
-        exclude_self=cfg.exclude_self,
-        exclude_zero=cfg.exclude_zero,
-        zero_eps=cfg.zero_eps,
-        scale=pair_scale,
-    )
+    top-k, streamed into the carry. The ring backends' per-round body (a
+    rotating block is inherently stream-merged)."""
+    d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
     all_d = jnp.concatenate([carry_d, d.astype(carry_d.dtype)], axis=-1)
     all_i = jnp.concatenate(
         [carry_i, jnp.broadcast_to(blk_ids[None, :], d.shape)], axis=-1
@@ -79,6 +100,7 @@ def knn_tile_step(
         cfg.k,
         method=cfg.topk_method,
         recall_target=cfg.recall_target,
+        block=cfg.topk_block,
     )
 
 
@@ -104,6 +126,41 @@ def knn_chunk_update(
     def per_query_tile(args):
         q_x, q_ids, cd, ci = args
         q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
+
+        if cfg.merge_schedule == "twolevel":
+            # level 1: independent local top-k per corpus tile (no carry
+            # dependence between scan steps — XLA can pipeline the sort of
+            # tile t with the matmul of tile t+1)
+            def local(_, tile):
+                blk, blk_ids, blk_sq = tile
+                d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
+                ld, li = smallest_k(
+                    d.astype(cd.dtype),
+                    blk_ids,
+                    cfg.k,
+                    method=cfg.topk_method,
+                    recall_target=cfg.recall_target,
+                    block=cfg.topk_block,
+                )
+                return None, (ld, li)
+
+            _, (ld, li) = jax.lax.scan(
+                local, None, (chunk_tiles, chunk_ids, chunk_sq)
+            )
+            # level 2: one narrow merge over the incoming carry plus every
+            # tile's k survivors — (n_tiles+1)·k columns instead of a
+            # (carry ‖ c_tile)-wide reduction per tile
+            n_tiles = ld.shape[0]
+            q_rows = cd.shape[0]
+            ld = jnp.moveaxis(ld, 0, 1).reshape(q_rows, n_tiles * cfg.k)
+            li = jnp.moveaxis(li, 0, 1).reshape(q_rows, n_tiles * cfg.k)
+            return cascade_smallest_k(
+                jnp.concatenate([cd, ld], axis=-1),
+                jnp.concatenate([ci, li], axis=-1),
+                cfg.k,
+                method="exact",
+                block=cfg.topk_block,
+            )
 
         def step(carry, tile):
             blk, blk_ids, blk_sq = tile

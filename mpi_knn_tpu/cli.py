@@ -22,7 +22,14 @@ import sys
 
 import numpy as np
 
-from mpi_knn_tpu.config import BACKENDS, METRICS, TIE_BREAKS, KNNConfig
+from mpi_knn_tpu.config import (
+    BACKENDS,
+    MERGE_SCHEDULES,
+    METRICS,
+    TIE_BREAKS,
+    TOPK_METHODS,
+    KNNConfig,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -67,7 +74,16 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--corpus-tile", type=int, default=2048)
     k.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16", "float64"])
-    k.add_argument("--topk-method", choices=["exact", "approx"], default="exact")
+    k.add_argument("--topk-method", choices=list(TOPK_METHODS), default="exact",
+                   help="exact lax.top_k; approx_min_k partial reduction; or "
+                   "block — exact narrow-sort two-level reduction (fastest "
+                   "exact method on TPU, BASELINE.md r3)")
+    k.add_argument("--topk-block", type=int, default=128,
+                   help="first-level sort width for --topk-method=block")
+    k.add_argument("--merge-schedule", choices=list(MERGE_SCHEDULES),
+                   default="twolevel",
+                   help="serial-core tile merge: stream (carry per tile) or "
+                   "twolevel (local top-k per tile + one cascade merge)")
     k.add_argument("--pallas-variant", choices=["tiles", "sweep"],
                    default="tiles",
                    help="pallas backend kernel shape: per-tile top-k + XLA "
@@ -249,6 +265,8 @@ def main(argv=None) -> int:
         corpus_tile=args.corpus_tile,
         dtype=args.dtype,
         topk_method=args.topk_method,
+        topk_block=args.topk_block,
+        merge_schedule=args.merge_schedule,
         pallas_variant=args.pallas_variant,
         exclude_zero=not args.include_zero_dist,
         exclude_self=not args.include_self,

@@ -14,7 +14,8 @@ from typing import Optional, Sequence
 
 BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
-TOPK_METHODS = ("exact", "approx")
+TOPK_METHODS = ("exact", "approx", "block")
+MERGE_SCHEDULES = ("stream", "twolevel")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
 
@@ -42,10 +43,15 @@ class KNNConfig:
         distance — the reference's semantics, which also drops exact duplicate
         points (``sqrt(S) != 0``, ``/root/reference/knn-serial.c:86``).
       zero_eps: threshold for ``exclude_zero`` in squared-distance space.
-      topk_method: ``exact`` (``lax.top_k``) or ``approx``
+      topk_method: ``exact`` (``lax.top_k``), ``approx``
         (``lax.approx_min_k``, the TPU-optimized partial reduction from the
-        TPU-KNN paper — see PAPERS.md).
+        TPU-KNN paper — see PAPERS.md), or ``block`` (exact two-level
+        reduction via narrow per-block sorts — ops/topk.py ``smallest_k``).
       recall_target: recall target for ``approx`` top-k.
+      topk_block: first-level sort width for ``block``.
+      merge_schedule: ``stream`` (carry merged per corpus tile) or
+        ``twolevel`` (local top-k per tile, one cascade merge at the end) —
+        how the serial core combines per-tile candidates.
       tie_break: vote tie-break. ``nearest`` = correct majority vote with
         nearest-neighbor tie-break; ``lowest`` = lowest class id wins ties;
         ``quirk-serial`` / ``quirk-mpi`` bit-replicate the reference's buggy
@@ -75,6 +81,17 @@ class KNNConfig:
     zero_eps: float = 0.0
     topk_method: str = "exact"
     recall_target: float = 0.95
+    # first-level sort width for topk_method="block" (an EXACT method: per-
+    # block top-k then top-k over survivors — narrow VPU sorts instead of one
+    # corpus-tile-wide sort; see ops/topk.py smallest_k)
+    topk_block: int = 128
+    # how the serial/resumable core combines per-corpus-tile candidates:
+    # "stream" = carry threaded through the tile scan, one (carry ‖ tile)-wide
+    # top-k per tile (the reference's accumulate-as-you-go shape,
+    # /root/reference/knn-serial.c:86-91, batched); "twolevel" = local top-k
+    # per tile, then ONE narrow cascade merge over all n_tiles·k survivors —
+    # fewer wide reductions, chosen by on-chip A/B (BASELINE.md r3).
+    merge_schedule: str = "twolevel"
     tie_break: str = "nearest"
     num_classes: int = 10
     mesh_axis: str = "ring"
@@ -113,6 +130,13 @@ class KNNConfig:
                 f"pallas_variant must be one of {PALLAS_VARIANTS}, got "
                 f"{self.pallas_variant!r}"
             )
+        if self.merge_schedule not in MERGE_SCHEDULES:
+            raise ValueError(
+                f"merge_schedule must be one of {MERGE_SCHEDULES}, got "
+                f"{self.merge_schedule!r}"
+            )
+        if self.topk_block < 1:
+            raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")
 

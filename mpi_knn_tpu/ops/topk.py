@@ -34,12 +34,30 @@ def init_topk(num_queries: int, k: int, dtype=jnp.float32):
     return d, i
 
 
+def _fold_topk(dists: jax.Array, ids: jax.Array, k: int, width: int):
+    """Fold (q, c) candidate rows into (q, ceil(c/width)·k) by a per-chunk
+    top-k: pad the columns to a multiple of ``width`` with (+inf, -1), sort
+    each width-column chunk, keep k survivors each. Every global top-k
+    element survives its own chunk's top-k, so folding is exact. The shared
+    primitive behind the "block" method and the cascade merge."""
+    q, c = dists.shape
+    nch = -(-c // width)
+    pad = nch * width - c
+    if pad:
+        dists = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=_INF)
+        ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=INVALID_ID)
+    neg, pos = jax.lax.top_k(-dists.reshape(q, nch, width), k)
+    out_ids = jnp.take_along_axis(ids.reshape(q, nch, width), pos, axis=-1)
+    return (-neg).reshape(q, nch * k), out_ids.reshape(q, nch * k)
+
+
 def smallest_k(
     dists: jax.Array,
     ids: jax.Array,
     k: int,
     method: str = "exact",
     recall_target: float = 0.95,
+    block: int = 128,
 ):
     """Per-row k smallest entries of a (q, c) tile.
 
@@ -48,7 +66,15 @@ def smallest_k(
       ids: (c,) or (q, c) int32 global candidate ids.
       k: how many to keep. If k > c the result is padded with (+inf, -1).
       method: "exact" = lax.top_k on negated distances; "approx" =
-        lax.approx_min_k (TPU-optimized partial reduction, PAPERS.md TPU-KNN).
+        lax.approx_min_k (TPU-optimized partial reduction, PAPERS.md TPU-KNN);
+        "block" = EXACT two-level reduction — per-``block``-column top-k
+        (narrow sorts) followed by a top-k over the nb·k survivors. Every
+        global top-k element is in its own block's top-k, so the result is
+        identical to "exact"; what changes is the sort width (``block``
+        instead of ``c``), which is both faster on the VPU and avoids the
+        very-wide-sort transport wedge observed at c ≳ 60k (BASELINE.md).
+      recall_target: recall target for "approx".
+      block: column width of the first-level sort for "block".
 
     Returns:
       (q, k) dists ascending, (q, k) ids.
@@ -61,7 +87,10 @@ def smallest_k(
         dists = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=_INF)
         ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=INVALID_ID)
         c = k
-    if method == "approx":
+    if method == "block" and k <= block and c > block:
+        dists, ids = _fold_topk(dists, ids, k, block)
+        c = dists.shape[-1]
+    if method == "approx" and c > k:
         vals, pos = jax.lax.approx_min_k(dists, k, recall_target=recall_target)
     else:
         neg, pos = jax.lax.top_k(-dists, k)
@@ -72,6 +101,35 @@ def smallest_k(
     return vals, out_ids
 
 
+def cascade_smallest_k(
+    dists: jax.Array,
+    ids: jax.Array,
+    k: int,
+    method: str = "exact",
+    recall_target: float = 0.95,
+    block: int = 128,
+    max_width: int = 8192,
+):
+    """``smallest_k`` for arbitrarily wide candidate rows: while the row is
+    wider than ``max_width``, fold it by a per-chunk top-k (chunks of
+    ``max_width`` columns → k survivors each), then finish with one narrow
+    ``smallest_k``. Exact when ``method`` is exact/block (each fold keeps
+    every possible global top-k element). Used by the two-level merge
+    schedule, whose concatenated per-tile survivors can reach
+    n_tiles·k ≫ 8k columns at SIFT scale with large k."""
+    q, c = dists.shape
+    if ids.ndim == 1:
+        ids = jnp.broadcast_to(ids[None, :], (q, c))
+    # fold width must be >= 2k: chunks narrower than k would break top_k, and
+    # chunks of exactly k would make no progress (ceil(c/k)·k >= c)
+    fold_w = max(max_width, 2 * k)
+    while dists.shape[-1] > fold_w:
+        dists, ids = _fold_topk(dists, ids, k, fold_w)
+    return smallest_k(
+        dists, ids, k, method=method, recall_target=recall_target, block=block
+    )
+
+
 def merge_topk(
     carry_d: jax.Array,
     carry_i: jax.Array,
@@ -79,6 +137,7 @@ def merge_topk(
     new_i: jax.Array,
     method: str = "exact",
     recall_target: float = 0.95,
+    block: int = 128,
 ):
     """Merge two per-query top-k lists into one: top_k over the concatenation.
 
@@ -89,7 +148,8 @@ def merge_topk(
     k = carry_d.shape[-1]
     d = jnp.concatenate([carry_d, new_d], axis=-1)
     i = jnp.concatenate([carry_i, new_i], axis=-1)
-    return smallest_k(d, i, k, method=method, recall_target=recall_target)
+    return smallest_k(d, i, k, method=method, recall_target=recall_target,
+                      block=block)
 
 
 # relative tolerance for "numerically zero" squared distances: the matmul form

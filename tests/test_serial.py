@@ -93,6 +93,39 @@ def test_off_center_cluster_keeps_neighbors(rng):
     )
 
 
+@pytest.mark.parametrize("schedule", ["stream", "twolevel"])
+@pytest.mark.parametrize("method", ["exact", "block"])
+def test_merge_schedule_method_parity(rng, schedule, method):
+    """Every (merge_schedule × exact-family topk_method) combination must
+    agree with the oracle — including non-divisible m/q and k spanning
+    multiple tiles' survivors."""
+    X, _ = _blobs(rng, m=131, d=9)
+    got = all_knn(
+        X,
+        k=9,
+        backend="serial",
+        query_tile=32,
+        corpus_tile=24,
+        merge_schedule=schedule,
+        topk_method=method,
+        topk_block=16,
+    )
+    want_d, want_i = oracle_all_knn(X, k=9)
+    _assert_knn_matches(got, want_d, want_i)
+
+
+def test_twolevel_matches_stream_bitwise(rng):
+    """The two schedules reduce the same candidate multiset — ids must agree
+    exactly (same fp distance values, same tie handling via stable top_k)."""
+    X, _ = _blobs(rng, m=97, d=11)
+    a = all_knn(X, k=6, backend="serial", query_tile=16, corpus_tile=32,
+                merge_schedule="stream")
+    b = all_knn(X, k=6, backend="serial", query_tile=16, corpus_tile=32,
+                merge_schedule="twolevel")
+    np.testing.assert_array_equal(np.asarray(a.dists), np.asarray(b.dists))
+    np.testing.assert_array_equal(np.asarray(a.ids), np.asarray(b.ids))
+
+
 def test_cosine_metric(rng):
     X, _ = _blobs(rng, m=90, d=10)
     got = all_knn(X, k=5, backend="serial", metric="cosine", query_tile=32, corpus_tile=32)

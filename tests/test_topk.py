@@ -2,7 +2,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mpi_knn_tpu.ops.topk import init_topk, mask_tile, merge_topk, smallest_k
+from mpi_knn_tpu.ops.topk import (
+    cascade_smallest_k,
+    init_topk,
+    mask_tile,
+    merge_topk,
+    smallest_k,
+)
 from mpi_knn_tpu.types import INVALID_ID
 
 
@@ -85,6 +91,58 @@ def test_mask_tile_zero_eps():
     cand = jnp.asarray([0, 1], dtype=jnp.int32)
     out = np.asarray(mask_tile(d, cand, exclude_self=False, exclude_zero=True, zero_eps=1e-12))
     assert np.isinf(out[0, 0]) and not np.isinf(out[0, 1])
+
+
+@pytest.mark.parametrize("c,block", [(40, 8), (129, 16), (256, 128), (30, 64)])
+def test_block_method_is_exact(rng, c, block):
+    """topk_method='block' must be bit-identical to exact for every shape:
+    wider-than-block rows (two-level path), non-divisible widths (inf
+    padding), and narrower-than-block rows (falls through to plain exact)."""
+    d = rng.standard_normal((9, c)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(c, dtype=np.int32), (9, c))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 7, method="block", block=block
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 7)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
+def test_block_method_k_exceeding_block_falls_back(rng):
+    d = rng.standard_normal((4, 60)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(60, dtype=np.int32), (4, 60))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 12, method="block", block=8
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 12)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
+def test_block_method_keeps_inf_invalid(rng):
+    d = jnp.full((3, 200), jnp.inf)
+    got_d, got_i = smallest_k(
+        d, jnp.arange(200, dtype=jnp.int32), 5, method="block", block=64
+    )
+    assert np.isinf(np.asarray(got_d)).all()
+    assert (np.asarray(got_i) == INVALID_ID).all()
+
+
+@pytest.mark.parametrize(
+    "c,k,max_width",
+    [(100, 5, 16), (513, 5, 64), (50, 5, 512), (100, 20, 8), (41, 3, 7)],
+)
+def test_cascade_smallest_k_matches_exact(rng, c, k, max_width):
+    """Including max_width < k (fold width must self-correct to >= 2k) and
+    non-divisible chunking."""
+    d = rng.standard_normal((6, c)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(c, dtype=np.int32), (6, c))
+    got_d, got_i = cascade_smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), k, max_width=max_width
+    )
+    want_d, want_i = _np_smallest_k(d, ids, k)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
 
 
 def test_approx_method_runs_on_cpu(rng):
