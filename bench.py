@@ -117,6 +117,19 @@ def main() -> int:
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
         matmul_precision=os.environ.get("BENCH_PRECISION") or None,
+        # BENCH_CENTER=0: skip mean-centering. Raw MNIST pixels are small
+        # integers — exactly representable even in bf16 — so the uncentered
+        # bf16 path computes exact products where the *centered* (non-integer)
+        # path loses mantissa bits. The relative zero-exclusion threshold is
+        # calibrated for CENTERED data (ops/topk.py); uncentered norms
+        # (~1e7) would stretch it to ~10 in squared space, so pair the knob
+        # with an explicit absolute epsilon: above the uncentered fp noise of
+        # a true duplicate (≲16 at these magnitudes), orders below genuine
+        # MNIST neighbor distances (~1e5).
+        center=os.environ.get("BENCH_CENTER", "1") != "0",
+        zero_eps=(
+            64.0 if os.environ.get("BENCH_CENTER", "1") == "0" else 0.0
+        ),
     )
 
     # data to device ONCE — the timed region is the all-kNN phase, matching

@@ -28,6 +28,7 @@ def all_knn(
     queries=None,
     config: Optional[KNNConfig] = None,
     mesh=None,
+    query_ids=None,
     **overrides,
 ) -> KNNResult:
     """All-kNN search.
@@ -40,6 +41,11 @@ def all_knn(
       config: KNNConfig; individual fields may be overridden by kwargs, e.g.
         ``all_knn(X, k=10, backend="ring")``.
       mesh: optional jax.sharding.Mesh for the ring backends.
+      query_ids: optional (q,) int32 corpus identities for explicit
+        ``queries`` — when the queries are a subset of the corpus, passing
+        their corpus row indices preserves all-pairs self-exclusion for the
+        sampled rows (the sampled recall gate's use). Ignored in all-pairs
+        mode (identities are implicit); -1 entries mean "no identity".
 
     Returns:
       KNNResult with (q, k) distances (sortable space, ascending) and 0-based
@@ -56,9 +62,16 @@ def all_knn(
         q_ids = np.arange(m, dtype=np.int32)
     else:
         q_arr = queries if isinstance(queries, jax.Array) else np.asarray(queries)
-        # no query has a corpus identity in query mode; -1 never matches a
-        # *valid* candidate id, so self-exclusion is a no-op
-        q_ids = np.full(q_arr.shape[0], -1, dtype=np.int32)
+        if query_ids is not None:
+            q_ids = np.asarray(query_ids, dtype=np.int32)
+            if q_ids.shape != (q_arr.shape[0],):
+                raise ValueError(
+                    f"query_ids shape {q_ids.shape} != ({q_arr.shape[0]},)"
+                )
+        else:
+            # no query has a corpus identity in query mode; -1 never matches
+            # a *valid* candidate id, so self-exclusion is a no-op
+            q_ids = np.full(q_arr.shape[0], -1, dtype=np.int32)
 
     if cfg.center and cfg.metric == "l2":
         from mpi_knn_tpu.ops.distance import center_for_l2

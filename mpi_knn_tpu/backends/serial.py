@@ -158,7 +158,9 @@ def knn_chunk_update(
                 jnp.concatenate([cd, ld], axis=-1),
                 jnp.concatenate([ci, li], axis=-1),
                 cfg.k,
-                method="exact",
+                # survivors-of-survivors must merge exactly or recall decays
+                # multiplicatively; "block" is exact, only "approx" is not
+                method="exact" if cfg.topk_method == "approx" else cfg.topk_method,
                 block=cfg.topk_block,
             )
 

@@ -1,0 +1,26 @@
+"""Real handwritten-digit data, offline.
+
+The reference's workload is real digit images from ``mnist_train.mat``
+(``/root/reference/knn-serial.c:40``); that file was stripped from the
+snapshot (``.MISSING_LARGE_BLOBS:1``) and this sandbox has no network to
+re-download MNIST (documented in BASELINE.md). The UCI handwritten-digits
+set bundled with scikit-learn (1797 × 64, classes 0-9 — real pen-written
+digits, 8×8) is the genuine-data stand-in: same task shape (digit
+classification by leave-one-out kNN vote), real labels, real pixel data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_digits() -> tuple[np.ndarray, np.ndarray]:
+    """Returns (X float32 (1797, 64), labels int32 0-9)."""
+    try:
+        from sklearn.datasets import load_digits as _sk_load
+    except ImportError as e:  # pragma: no cover - sklearn is in the image
+        raise RuntimeError(
+            "the 'digits' data source needs scikit-learn (not installed)"
+        ) from e
+    d = _sk_load()
+    return d.data.astype(np.float32), d.target.astype(np.int32)

@@ -223,6 +223,22 @@ def test_cli_recall_vs_serial(capsys):
     assert "recall-vs-serial=1.0000" in out
 
 
+def test_cli_recall_gate_sampled(tmp_path):
+    """The sampled gate must agree with serial ground truth: the sampled
+    queries keep their corpus identity (self-exclusion parity), so recall
+    is exactly 1.0 for an exact distributed backend."""
+    rep = tmp_path / "r.json"
+    rc = cli_main(
+        ["--data", "synthetic:300x8c4", "--k", "4", "--num-classes", "4",
+         "--backend", "ring-overlap", "--recall-vs-serial",
+         "--recall-sample", "32", "--report", str(rep), "-q"]
+    )
+    assert rc == 0
+    body = json.loads(rep.read_text())
+    assert body["recall_vs_baseline"] == 1.0
+    assert body["notes"]["recall_sample"] == 32
+
+
 def test_cli_sift_spec(capsys):
     rc = cli_main(
         ["--data", "sift:512", "--k", "3", "--backend", "serial",
