@@ -93,6 +93,9 @@ def main() -> int:
               file=sys.stderr)
         return 2
     backend = os.environ.get("BENCH_BACKEND", "serial")
+    # BENCH_CENTER=0: skip mean-centering — read ONCE; the zero_eps pairing
+    # below derives from the same bool so the two can never desync
+    center = os.environ.get("BENCH_CENTER", "1") != "0"
 
     from mpi_knn_tpu import KNNConfig, all_knn
     from mpi_knn_tpu.data.mnist import load_mnist
@@ -117,19 +120,15 @@ def main() -> int:
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
         matmul_precision=os.environ.get("BENCH_PRECISION") or None,
-        # BENCH_CENTER=0: skip mean-centering. Raw MNIST pixels are small
-        # integers — exactly representable even in bf16 — so the uncentered
-        # bf16 path computes exact products where the *centered* (non-integer)
-        # path loses mantissa bits. The relative zero-exclusion threshold is
-        # calibrated for CENTERED data (ops/topk.py); uncentered norms
-        # (~1e7) would stretch it to ~10 in squared space, so pair the knob
-        # with an explicit absolute epsilon: above the uncentered fp noise of
-        # a true duplicate (≲16 at these magnitudes), orders below genuine
-        # MNIST neighbor distances (~1e5).
-        center=os.environ.get("BENCH_CENTER", "1") != "0",
-        zero_eps=(
-            64.0 if os.environ.get("BENCH_CENTER", "1") == "0" else 0.0
-        ),
+        # uncentered mode exists because raw MNIST pixels are small integers
+        # — exactly representable even in bf16 — where *centered* values lose
+        # mantissa bits. The relative zero-exclusion threshold is calibrated
+        # for centered data (ops/topk.py), so uncentered runs switch to an
+        # absolute epsilon: above the fp noise of a true duplicate at these
+        # magnitudes (≲16 in squared space), orders below genuine MNIST
+        # neighbor distances (~1e5).
+        center=center,
+        zero_eps=0.0 if center else 64.0,
     )
 
     # data to device ONCE — the timed region is the all-kNN phase, matching

@@ -51,4 +51,8 @@ def make_mnist_like(m: int = 60000, d: int = 784, seed: int = 0):
     centers = rng.random((10, d)) * 255.0
     y = rng.integers(0, 10, size=m).astype(np.int32)
     X = centers[y] + rng.standard_normal((m, d)) * 25.0
-    return np.clip(X, 0.0, 255.0).astype(np.float32), y
+    # real MNIST pixels are INTEGERS in [0, 255]; keeping the surrogate
+    # integral preserves that property's numeric consequences (integers
+    # ≤ 255 are exactly representable even in bf16, so uncentered bf16
+    # distance products are exact — BASELINE.md r3)
+    return np.clip(np.rint(X), 0.0, 255.0).astype(np.float32), y

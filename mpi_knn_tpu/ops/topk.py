@@ -91,6 +91,15 @@ def smallest_k(
         dists, ids = _fold_topk(dists, ids, k, block)
         c = dists.shape[-1]
     if method == "approx" and c > k:
+        # lane-align the reduction input: approx_min_k over a width that is
+        # not a multiple of 128 (e.g. the stream schedule's carry‖tile concat,
+        # k+8192 wide) was observed to hang the tunneled device transport,
+        # while 128-aligned widths run clean (BASELINE.md r3). +inf/-1
+        # padding cannot enter the result.
+        pad = (-c) % 128
+        if pad:
+            dists = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=_INF)
+            ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=INVALID_ID)
         vals, pos = jax.lax.approx_min_k(dists, k, recall_target=recall_target)
     else:
         neg, pos = jax.lax.top_k(-dists, k)

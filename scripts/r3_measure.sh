@@ -1,0 +1,125 @@
+#!/bin/bash
+# Round-3 hardware measurement suite. Runs every pending measurement
+# SEQUENTIALLY (one TPU process at a time — concurrent access and wedge
+# aftermath both poison results), with a health probe between steps so a
+# wedged transport aborts the remainder instead of producing a row of
+# watchdog artifacts. Results append to measurements/r3.jsonl.
+#
+# Usage: bash scripts/r3_measure.sh [step ...]   (default: all steps)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements profiles
+OUT=measurements/r3.jsonl
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+assert float((x @ x).sum()) == 256.0 * 256 * 256
+EOF
+}
+
+wait_alive() {
+  for i in $(seq 1 "${PROBE_RETRIES:-10}"); do
+    probe && return 0
+    echo "probe $i: device unresponsive; waiting 120s" >&2
+    sleep 120
+  done
+  return 1
+}
+
+note() { echo "{\"step\": \"$1\", \"status\": \"$2\", \"ts\": \"$(date -Is)\"}" >> "$OUT"; }
+
+run_step() { # name timeout_s command...
+  local name=$1 tmo=$2; shift 2
+  if ! wait_alive; then
+    # a dead transport will not heal mid-suite; abort instead of burning
+    # a 20-minute retry window per remaining step
+    note "$name" "ABORT-device-dead"
+    echo "== $name: device dead, aborting suite" >&2
+    exit 1
+  fi
+  echo "== $name" >&2
+  local line
+  if line=$(timeout "$tmo" "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+    echo "$line" | sed "s/^{/{\"step\": \"$name\", /" >> "$OUT"
+  else
+    note "$name" "FAILED-or-timeout"
+  fi
+}
+
+STEPS="${*:-confirm ct12288 ct16384 qt8192 approx95 bf16raw mfu tputests svd sift100 sift1m ring_ab ring_approx}"
+
+for s in $STEPS; do case $s in
+confirm)  # candidate default: twolevel/exact/high 8192
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high BENCH_CT=8192 \
+  BENCH_WATCHDOG_S=240 run_step bench-twolevel-high-8192 300 python bench.py ;;
+ct12288)
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high BENCH_CT=12288 \
+  BENCH_WATCHDOG_S=240 run_step bench-ct12288 300 python bench.py ;;
+ct16384)
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high BENCH_CT=16384 \
+  BENCH_WATCHDOG_S=240 run_step bench-ct16384 300 python bench.py ;;
+qt8192)
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high BENCH_QT=8192 \
+  BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-qt8192 300 python bench.py ;;
+approx95)  # measured recall decides, not the target knob
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=approx BENCH_RT=0.95 BENCH_PRECISION=high \
+  BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-approx-rt95 300 python bench.py ;;
+bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
+  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_DTYPE=bfloat16 BENCH_CENTER=0 \
+  BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-bf16-uncentered 300 python bench.py ;;
+mfu)
+  run_step mfu 1800 python scripts/profile_mfu.py \
+    --variants twolevel,stream,pallas-tiles,pallas-sweep --precision high \
+    --profile-dir profiles/r3 --json measurements/mfu.json ;;
+tputests)
+  if wait_alive; then
+    echo "== tpu test subset" >&2
+    TKNN_TPU_TESTS=1 timeout 1800 python -m pytest tests/ -q \
+      > measurements/tpu_tests.txt 2>&1
+    tail -1 measurements/tpu_tests.txt | \
+      sed 's/^/{"step": "tputests", "result": "/; s/$/"}/' >> "$OUT"
+  fi ;;
+svd)
+  for k in 1 10 100; do
+    run_step svd64-k$k 600 python -m mpi_knn_tpu --data mnist --svd 64 \
+      --k "$k" --loo -q --report "measurements/svd64_k$k.json"
+    [ -f "measurements/svd64_k$k.json" ] && python - "$k" <<'EOF' >> "$OUT"
+import json, sys
+k = sys.argv[1]
+r = json.load(open(f"measurements/svd64_k{k}.json"))
+print(json.dumps({"step": f"svd64-k{k}", "phase_seconds": r["phase_seconds"],
+                  "accuracy": r.get("accuracy"), "backend": r["backend"]}))
+EOF
+  done ;;
+sift100)
+  for mtr in l2 cosine; do for tk in exact approx; do
+    run_step "sift100k-$mtr-$tk" 900 python scripts/sift_bench.py \
+      --m 100000 --metric "$mtr" --topk "$tk" --watchdog-s 600
+  done; done ;;
+sift1m)
+  for mtr in l2 cosine; do for tk in approx exact; do
+    run_step "sift1m-$mtr-$tk" 2400 python scripts/sift_bench.py \
+      --m 1000000 --metric "$mtr" --topk "$tk" --watchdog-s 1800
+  done; done ;;
+ring_ab)
+  run_step ring-ab-1dev 900 python scripts/ring_ab.py --m 60000 --d 784 \
+    --k 10 --devices 1 --corpus-tile 8192 \
+    --profile-dir profiles/ring_ab --json measurements/ring_ab.json ;;
+ring_approx)
+  for tk in exact approx; do
+    run_step "ring256k-$tk" 900 python -m mpi_knn_tpu --data sift:262144 \
+      --k 10 --backend ring --devices 1 --topk-method "$tk" \
+      --recall-vs-serial -q --report "measurements/ring256k_$tk.json"
+    [ -f "measurements/ring256k_$tk.json" ] && python - "$tk" <<'EOF' >> "$OUT"
+import json, sys
+tk = sys.argv[1]
+r = json.load(open(f"measurements/ring256k_{tk}.json"))
+print(json.dumps({"step": f"ring256k-{tk}", "phase_seconds": r["phase_seconds"],
+                  "recall_vs_baseline": r.get("recall_vs_baseline")}))
+EOF
+  done ;;
+*) echo "unknown step $s" >&2 ;;
+esac; done
+echo "DONE -> $OUT" >&2

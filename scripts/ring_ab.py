@@ -39,6 +39,11 @@ def main(argv=None) -> int:
     ap.add_argument("--query-tile", type=int, default=1024)
     ap.add_argument("--corpus-tile", type=int, default=4096)
     ap.add_argument("--json", default=None, help="also write results here")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture one XProf trace per schedule into "
+                    "DIR/{blocking,overlap} — the overlap-evidence artifact "
+                    "(where does the ppermute DMA sit relative to the "
+                    "distance matmul?)")
     ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
                     default="auto")
     args = ap.parse_args(argv)
@@ -86,6 +91,11 @@ def main(argv=None) -> int:
             device_sync(res.dists, res.ids)
             times.append(time.perf_counter() - t0)
         results[name] = min(times)
+        if args.profile_dir:
+            tdir = str(Path(args.profile_dir) / name)
+            with jax.profiler.trace(tdir):
+                res = all_knn(Xd, config=cfg, mesh=mesh)
+                device_sync(res.dists, res.ids)
         # sample neighbor ids for the A==B sanity check (full fetch would be
         # slow over tunneled transports)
         sample = jnp.asarray(
