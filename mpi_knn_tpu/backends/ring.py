@@ -45,7 +45,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import sq_norms
 from mpi_knn_tpu.ops.topk import init_topk
-from mpi_knn_tpu.backends.serial import cap_corpus_tile, knn_tile_step
+from mpi_knn_tpu.backends.serial import (
+    cap_corpus_tile,
+    merge_tiles_into_carry,
+)
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
@@ -122,20 +125,13 @@ def _ring_knn_local(
         def per_query_tile(args):
             q_x, q_ids, cd0, ci0 = args
             q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
-
-            def inner(carry, tile):
-                t_blk, t_ids, t_sq = tile
-                return (
-                    knn_tile_step(
-                        q_x, q_ids, q_sq, t_blk, t_ids, t_sq, *carry, cfg
-                    ),
-                    None,
-                )
-
-            out, _ = jax.lax.scan(
-                inner, (cd0, ci0), (blk_tiles, blk_id_tiles, blk_sq)
+            # within a round the block's tiles merge per cfg.merge_schedule
+            # (same code path as serial); the cross-ROUND merge is inherently
+            # streaming — each rotation step merges into the carry
+            return merge_tiles_into_carry(
+                q_x, q_ids, q_sq, blk_tiles, blk_id_tiles, blk_sq,
+                cd0, ci0, cfg,
             )
-            return out
 
         return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, cd, ci))
 

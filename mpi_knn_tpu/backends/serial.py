@@ -126,55 +126,77 @@ def knn_chunk_update(
     def per_query_tile(args):
         q_x, q_ids, cd, ci = args
         q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
-
-        if cfg.merge_schedule == "twolevel":
-            # level 1: independent local top-k per corpus tile (no carry
-            # dependence between scan steps — XLA can pipeline the sort of
-            # tile t with the matmul of tile t+1)
-            def local(_, tile):
-                blk, blk_ids, blk_sq = tile
-                d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
-                ld, li = smallest_k(
-                    d.astype(cd.dtype),
-                    blk_ids,
-                    cfg.k,
-                    method=cfg.topk_method,
-                    recall_target=cfg.recall_target,
-                    block=cfg.topk_block,
-                )
-                return None, (ld, li)
-
-            _, (ld, li) = jax.lax.scan(
-                local, None, (chunk_tiles, chunk_ids, chunk_sq)
-            )
-            # level 2: one narrow merge over the incoming carry plus every
-            # tile's k survivors — (n_tiles+1)·k columns instead of a
-            # (carry ‖ c_tile)-wide reduction per tile
-            n_tiles = ld.shape[0]
-            q_rows = cd.shape[0]
-            ld = jnp.moveaxis(ld, 0, 1).reshape(q_rows, n_tiles * cfg.k)
-            li = jnp.moveaxis(li, 0, 1).reshape(q_rows, n_tiles * cfg.k)
-            return cascade_smallest_k(
-                jnp.concatenate([cd, ld], axis=-1),
-                jnp.concatenate([ci, li], axis=-1),
-                cfg.k,
-                # survivors-of-survivors must merge exactly or recall decays
-                # multiplicatively; "block" is exact, only "approx" is not
-                method="exact" if cfg.topk_method == "approx" else cfg.topk_method,
-                block=cfg.topk_block,
-            )
-
-        def step(carry, tile):
-            blk, blk_ids, blk_sq = tile
-            return (
-                knn_tile_step(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, *carry, cfg),
-                None,
-            )
-
-        out, _ = jax.lax.scan(step, (cd, ci), (chunk_tiles, chunk_ids, chunk_sq))
-        return out
+        return merge_tiles_into_carry(
+            q_x, q_ids, q_sq, chunk_tiles, chunk_ids, chunk_sq, cd, ci, cfg
+        )
 
     return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, carry_d, carry_i))
+
+
+def merge_tiles_into_carry(
+    q_x: jax.Array,  # (q_tile, d)
+    q_ids: jax.Array,  # (q_tile,)
+    q_sq: jax.Array | None,
+    tiles: jax.Array,  # (T, c_tile, d)
+    tile_ids: jax.Array,  # (T, c_tile)
+    tile_sqs: jax.Array,  # (T, c_tile)
+    carry_d: jax.Array,  # (q_tile, k)
+    carry_i: jax.Array,
+    cfg: KNNConfig,
+):
+    """Merge a stack of corpus tiles into one query tile's top-k carry, per
+    ``cfg.merge_schedule``. The single implementation behind the serial
+    chunk scan and the ring backends' per-round block loop (the schedules
+    must match or the ring's per-round cost diverges from serial's).
+
+    - "twolevel": level 1 — independent local top-k per corpus tile (no
+      carry dependence between scan steps, so XLA can pipeline the sort of
+      tile t with the matmul of tile t+1); level 2 — ONE narrow cascade
+      merge over the incoming carry plus every tile's k survivors,
+      (n_tiles+1)·k columns instead of a (carry ‖ c_tile)-wide reduction
+      per tile. Measured faster on v5e (BASELINE.md r3), now the default.
+    - "stream": carry threaded through the tile scan — the reference's
+      accumulate-as-you-go shape (``knn-serial.c:86-91``), batched.
+    """
+    if cfg.merge_schedule == "twolevel":
+
+        def local(_, tile):
+            blk, blk_ids, blk_sq = tile
+            d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
+            ld, li = smallest_k(
+                d.astype(carry_d.dtype),
+                blk_ids,
+                cfg.k,
+                method=cfg.topk_method,
+                recall_target=cfg.recall_target,
+                block=cfg.topk_block,
+            )
+            return None, (ld, li)
+
+        _, (ld, li) = jax.lax.scan(local, None, (tiles, tile_ids, tile_sqs))
+        n_tiles = ld.shape[0]
+        q_rows = carry_d.shape[0]
+        ld = jnp.moveaxis(ld, 0, 1).reshape(q_rows, n_tiles * cfg.k)
+        li = jnp.moveaxis(li, 0, 1).reshape(q_rows, n_tiles * cfg.k)
+        return cascade_smallest_k(
+            jnp.concatenate([carry_d, ld], axis=-1),
+            jnp.concatenate([carry_i, li], axis=-1),
+            cfg.k,
+            # survivors-of-survivors must merge exactly or recall decays
+            # multiplicatively; "block" is exact, only "approx" is not
+            method="exact" if cfg.topk_method == "approx" else cfg.topk_method,
+            block=cfg.topk_block,
+        )
+
+    def step(carry, tile):
+        blk, blk_ids, blk_sq = tile
+        return (
+            knn_tile_step(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, *carry, cfg),
+            None,
+        )
+
+    out, _ = jax.lax.scan(step, (carry_d, carry_i), (tiles, tile_ids, tile_sqs))
+    return out
 
 
 def cap_corpus_tile(q_tile: int, c_tile: int, max_tile_elems: int) -> int:

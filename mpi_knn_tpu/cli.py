@@ -421,7 +421,11 @@ def main(argv=None) -> int:
                 # an approx topk_method would let shared approximation error
                 # cancel and overstate recall
                 base_cfg = cfg.replace(backend="serial", topk_method="exact")
-                if queries is None:
+                if queries is None and full:
+                    # all-pairs baseline as-is; a sample == arange copy of
+                    # the corpus would upload the whole corpus twice
+                    base = all_knn(X, config=base_cfg)
+                elif queries is None:
                     # all-pairs mode: sampled rows keep their corpus identity
                     # so self-exclusion matches the full run
                     base = all_knn(

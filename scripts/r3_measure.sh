@@ -48,6 +48,22 @@ run_step() { # name timeout_s command...
   fi
 }
 
+run_report_step() { # name timeout_s report_file command...
+  local name=$1 tmo=$2 rep=$3; shift 3
+  if ! wait_alive; then
+    note "$name" "ABORT-device-dead"
+    echo "== $name: device dead, aborting suite" >&2
+    exit 1
+  fi
+  echo "== $name" >&2
+  if timeout "$tmo" "$@" >/dev/null 2>&1 && [ -f "$rep" ]; then
+    : # success: the caller extracts from the fresh report file
+  else
+    rm -f "$rep"  # a partial/absent report must not look like a result
+    note "$name" "FAILED-or-timeout"
+  fi
+}
+
 STEPS="${*:-confirm ct12288 ct16384 qt8192 approx95 bf16raw mfu tputests svd sift100 sift1m ring_ab ring_approx}"
 
 for s in $STEPS; do case $s in
@@ -83,7 +99,12 @@ tputests)
   fi ;;
 svd)
   for k in 1 10 100; do
-    run_step svd64-k$k 600 python -m mpi_knn_tpu --data mnist --svd 64 \
+    # report-file steps: the quiet CLI prints nothing to stdout, so success
+    # is "the report file exists afresh" — delete any stale one first so a
+    # failed run can't resurface an old measurement as new
+    rm -f "measurements/svd64_k$k.json"
+    run_report_step svd64-k$k 600 "measurements/svd64_k$k.json" \
+      python -m mpi_knn_tpu --data mnist --svd 64 \
       --k "$k" --loo -q --report "measurements/svd64_k$k.json"
     [ -f "measurements/svd64_k$k.json" ] && python - "$k" <<'EOF' >> "$OUT"
 import json, sys
@@ -109,7 +130,9 @@ ring_ab)
     --profile-dir profiles/ring_ab --json measurements/ring_ab.json ;;
 ring_approx)
   for tk in exact approx; do
-    run_step "ring256k-$tk" 900 python -m mpi_knn_tpu --data sift:262144 \
+    rm -f "measurements/ring256k_$tk.json"
+    run_report_step "ring256k-$tk" 900 "measurements/ring256k_$tk.json" \
+      python -m mpi_knn_tpu --data sift:262144 \
       --k 10 --backend ring --devices 1 --topk-method "$tk" \
       --recall-vs-serial -q --report "measurements/ring256k_$tk.json"
     [ -f "measurements/ring256k_$tk.json" ] && python - "$tk" <<'EOF' >> "$OUT"

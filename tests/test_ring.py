@@ -30,6 +30,22 @@ def test_ring_equals_serial_all_pairs(rng, backend):
     assert _as_sets(ring.ids) == _as_sets(serial.ids)
 
 
+@pytest.mark.parametrize("schedule", ["stream", "twolevel"])
+def test_ring_merge_schedule_parity(rng, schedule):
+    """The per-round block merge honors cfg.merge_schedule inside the ring
+    (shared merge_tiles_into_carry) — both schedules must equal serial, with
+    the block split across multiple on-device tiles so level 1 really runs
+    per tile."""
+    X = _data(rng, m=128)
+    serial = all_knn(X, k=6, backend="serial", query_tile=32, corpus_tile=32)
+    ring = all_knn(X, k=6, backend="ring", query_tile=8, corpus_tile=8,
+                   merge_schedule=schedule)
+    np.testing.assert_allclose(
+        np.asarray(ring.dists), np.asarray(serial.dists), rtol=1e-5, atol=1e-5
+    )
+    assert _as_sets(ring.ids) == _as_sets(serial.ids)
+
+
 @pytest.mark.parametrize("backend", ["ring", "ring-overlap"])
 def test_ring_non_divisible_m(rng, backend):
     """m=101 is not divisible by P=8 — the reference silently corrupted here
