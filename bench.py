@@ -119,7 +119,11 @@ def main() -> int:
         pallas_variant=os.environ.get("BENCH_PALLAS_VARIANT", "tiles"),
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
-        matmul_precision=os.environ.get("BENCH_PRECISION") or None,
+        # bench default HIGH (3-pass bf16): measured recall 1.0 on the
+        # integer-pixel corpus with ~4% median win over HIGHEST (r3 A/B,
+        # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
+        # its data; the library does not. BENCH_PRECISION overrides.
+        matmul_precision=os.environ.get("BENCH_PRECISION") or "high",
         # uncentered mode exists because raw MNIST pixels are small integers
         # — exactly representable even in bf16 — where *centered* values lose
         # mantissa bits. The relative zero-exclusion threshold is calibrated

@@ -226,6 +226,12 @@ def read_mat_native(path) -> Dict[str, np.ndarray]:
             name = lib.tknn_mat_var_name(h, i).decode()
             dims = (ctypes.c_int64 * 8)()
             nd = lib.tknn_mat_var_shape(h, name.encode(), dims, 8)
+            if nd > 8:
+                # the C API returns the FULL rank but fills at most max_dims
+                # slots; a truncated shape would undersize the read buffer
+                raise ValueError(
+                    f"{path}: variable {name!r} has {nd} dims (max 8)"
+                )
             shape = tuple(dims[j] for j in range(nd))
             buf = np.empty(int(np.prod(shape)) if shape else 0, dtype=np.float64)
             n = lib.tknn_mat_read_f64(

@@ -275,6 +275,31 @@ def test_cli_entrypoint_subprocess():
     assert r.returncode == 0, r.stderr[-2000:]
 
 
+def test_bench_driver_contract():
+    """`python bench.py` is THE driver interface: stdout must be exactly one
+    JSON line with metric/value/unit/vs_baseline, stderr must carry the
+    context object, and the default knobs must be the measured-best config
+    (twolevel schedule, exact top-k — BASELINE.md r3 A/B)."""
+    env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_M="1500",
+               BENCH_REPS="1", BENCH_WATCHDOG_S="0")
+    r = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd="/root/repo", timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l]
+    assert len(lines) == 1, r.stdout
+    head = json.loads(lines[0])
+    assert set(head) == {"metric", "value", "unit", "vs_baseline"}
+    assert head["unit"] == "s" and head["value"] > 0
+    ctx = json.loads(
+        [l for l in r.stderr.splitlines() if l.startswith("{")][-1]
+    )
+    assert ctx["merge_schedule"] == "twolevel"
+    assert ctx["topk_method"] == "exact"
+    assert ctx["recall_at_k_vs_oracle"] >= 0.999
+
+
 def test_ring_ab_script():
     """scripts/ring_ab.py runs both ring schedules and reports agreement."""
     r = subprocess.run(
