@@ -212,8 +212,12 @@ def load_native_lib(build: bool = True):
     return load_native("libtknn_matio.so", _bind, build=build)
 
 
-def read_mat_native(path) -> Dict[str, np.ndarray]:
-    lib = load_native_lib()
+def read_mat_native(path, lib=None) -> Dict[str, np.ndarray]:
+    """Read via the C++ parser. ``lib`` overrides the default library — the
+    ASan sweep passes the sanitizer-built .so so the PRODUCTION read loop
+    (this function) is what runs under the sanitizer."""
+    if lib is None:
+        lib = load_native_lib()
     if lib is None:
         raise RuntimeError("native MAT reader unavailable (build failed?)")
     h = lib.tknn_mat_open(str(path).encode())

@@ -77,18 +77,26 @@ def test_native_readers_asan_clean_on_genuine_matlab_files():
     )
     if mk.returncode != 0:
         pytest.skip(f"no ASan toolchain: {mk.stderr[-200:]}")
+    # the runtime must come from the SAME compiler family the Makefile used
+    # ($(CXX)); a gcc-located libasan under a clang-built .so aborts at
+    # interceptor init
+    cxx = os.environ.get("CXX", "g++")
+    if "clang" in cxx:
+        locator = [cxx, "-print-file-name=libclang_rt.asan-x86_64.so"]
+    else:
+        locator = [cxx.replace("g++", "gcc") if "g++" in cxx else cxx,
+                   "-print-file-name=libasan.so"]
     try:
         libasan = subprocess.run(
-            ["gcc", "-print-file-name=libasan.so"], capture_output=True,
-            text=True, timeout=30,
+            locator, capture_output=True, text=True, timeout=30,
         ).stdout.strip()
     except (OSError, subprocess.SubprocessError):
-        pytest.skip("no gcc to locate the ASan runtime")
+        pytest.skip(f"cannot locate the ASan runtime via {locator[0]}")
     if not os.path.isabs(libasan):
-        # gcc echoes the bare name back when it can't find the runtime;
-        # LD_PRELOADing that string silently does nothing and the ASan .so
-        # then aborts at load — skip instead
-        pytest.skip("gcc has no libasan.so")
+        # the compiler echoes the bare name back when it can't find the
+        # runtime; LD_PRELOADing that string silently does nothing and the
+        # ASan .so then aborts at load — skip instead
+        pytest.skip(f"{locator[0]} has no ASan runtime")
     data_dir = None
     try:
         import scipy.io as sio
@@ -101,29 +109,16 @@ def test_native_readers_asan_clean_on_genuine_matlab_files():
         pytest.skip("scipy matlab fixtures unavailable")
     code = f"""
 import ctypes, glob
-import numpy as np
-from mpi_knn_tpu.data.matfile import _bind
+from mpi_knn_tpu.data.matfile import _bind, read_mat_native
 lib = ctypes.CDLL('/root/repo/native/build/libtknn_matio_asan.so')
 _bind(lib)
 n_ok = n_err = 0
 for f in sorted(glob.glob({data_dir!r} + '/*.mat')):
-    h = lib.tknn_mat_open(f.encode())
-    if lib.tknn_mat_error(h).decode():
-        n_err += 1
-    else:
-        for i in range(lib.tknn_mat_num_vars(h)):
-            name = lib.tknn_mat_var_name(h, i).decode()
-            dims = (ctypes.c_int64 * 8)()
-            nd = lib.tknn_mat_var_shape(h, name.encode(), dims, 8)
-            if nd > 8:
-                continue  # rank beyond the shape buffer; production raises
-            sz = int(np.prod([dims[j] for j in range(nd)])) if nd else 0
-            buf = np.empty(max(sz, 1), dtype=np.float64)
-            lib.tknn_mat_read_f64(
-                h, name.encode(),
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    try:
+        read_mat_native(f, lib=lib)  # the PRODUCTION read loop, under ASan
         n_ok += 1
-    lib.tknn_mat_close(h)
+    except ValueError:
+        n_err += 1
 print('PARSED', n_ok, 'REJECTED', n_err)
 assert n_ok >= 70 and n_err >= 25
 """

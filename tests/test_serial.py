@@ -114,6 +114,35 @@ def test_merge_schedule_method_parity(rng, schedule, method):
     _assert_knn_matches(got, want_d, want_i)
 
 
+def test_schedule_equivalence_randomized(rng):
+    """Seeded randomized sweep: for random (m, d, k, tiles, method) configs
+    the two merge schedules must produce identical neighbor id sets and
+    distances — the associativity property that makes the schedule a pure
+    performance knob."""
+    for trial in range(12):
+        m = int(rng.integers(20, 220))
+        d = int(rng.integers(3, 24))
+        k = int(rng.integers(1, 17))
+        qt = int(rng.integers(4, 64))
+        ct = int(rng.integers(4, 96))
+        method = ["exact", "block"][trial % 2]
+        X, _ = _blobs(rng, m=m, d=d)
+        a = all_knn(X, k=k, backend="serial", query_tile=qt, corpus_tile=ct,
+                    merge_schedule="stream", topk_method=method,
+                    topk_block=16)
+        b = all_knn(X, k=k, backend="serial", query_tile=qt, corpus_tile=ct,
+                    merge_schedule="twolevel", topk_method=method,
+                    topk_block=16)
+        ctx = f"trial={trial} m={m} d={d} k={k} qt={qt} ct={ct} {method}"
+        np.testing.assert_array_equal(
+            np.asarray(a.dists), np.asarray(b.dists), err_msg=ctx
+        )
+        for r in range(m):
+            assert set(np.asarray(a.ids)[r]) == set(np.asarray(b.ids)[r]), (
+                f"{ctx} row {r}"
+            )
+
+
 def test_twolevel_matches_stream_bitwise(rng):
     """The two schedules reduce the same candidate multiset — ids must agree
     exactly (same fp distance values, same tie handling via stable top_k)."""
