@@ -89,9 +89,19 @@ bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
   BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_DTYPE=bfloat16 BENCH_CENTER=0 \
   BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-bf16-uncentered 300 python bench.py ;;
 mfu)
+  # fresh-only traces + fresh-only aggregate: stale artifacts must not
+  # resurface as this round's measurements
+  rm -rf profiles/r3; rm -f measurements/trace_ops_r3.json
   run_step mfu 1800 python scripts/profile_mfu.py \
     --variants twolevel,stream,pallas-tiles,pallas-sweep --precision high \
-    --profile-dir profiles/r3 --json measurements/mfu.json ;;
+    --profile-dir profiles/r3 --json measurements/mfu.json
+  # post-process the traces into op/category aggregates (host-side only)
+  if [ -d profiles/r3 ] && timeout 300 python scripts/trace_ops.py \
+      profiles/r3 --json measurements/trace_ops_r3.json >/dev/null 2>&1; then
+    note trace-ops-r3 "written"
+  else
+    note trace-ops-r3 "FAILED-or-missing"
+  fi ;;
 tputests)
   if wait_alive; then
     echo "== tpu test subset" >&2
@@ -128,9 +138,17 @@ sift1m)
       --m 1000000 --metric "$mtr" --topk "$tk" --watchdog-s 1800
   done; done ;;
 ring_ab)
+  rm -rf profiles/ring_ab; rm -f measurements/trace_ops_ring_ab.json
   run_step ring-ab-1dev 900 python scripts/ring_ab.py --m 60000 --d 784 \
     --k 10 --devices 1 --corpus-tile 8192 \
-    --profile-dir profiles/ring_ab --json measurements/ring_ab.json ;;
+    --profile-dir profiles/ring_ab --json measurements/ring_ab.json
+  if [ -d profiles/ring_ab ] && timeout 300 python scripts/trace_ops.py \
+      profiles/ring_ab --json measurements/trace_ops_ring_ab.json \
+      >/dev/null 2>&1; then
+    note trace-ops-ring-ab "written"
+  else
+    note trace-ops-ring-ab "FAILED-or-missing"
+  fi ;;
 ring_approx)
   for tk in exact approx; do
     rm -f "measurements/ring256k_$tk.json"
