@@ -183,8 +183,12 @@ def merge_tiles_into_carry(
             jnp.concatenate([carry_i, li], axis=-1),
             cfg.k,
             # survivors-of-survivors must merge exactly or recall decays
-            # multiplicatively; "block" is exact, only "approx" is not
-            method="exact" if cfg.topk_method == "approx" else cfg.topk_method,
+            # multiplicatively; "block" is exact, "approx"/"bf16" are not
+            method=(
+                cfg.topk_method
+                if cfg.topk_method in ("exact", "block")
+                else "exact"
+            ),
             block=cfg.topk_block,
         )
 

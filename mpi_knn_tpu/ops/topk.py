@@ -72,7 +72,10 @@ def smallest_k(
         global top-k element is in its own block's top-k, so the result is
         identical to "exact"; what changes is the sort width (``block``
         instead of ``c``), which is both faster on the VPU and avoids the
-        very-wide-sort transport wedge observed at c ≳ 60k (BASELINE.md).
+        very-wide-sort transport wedge observed at c ≳ 60k (BASELINE.md);
+        "bf16" = near-exact half-width-key preselect (4k candidates by
+        bf16 sort, exact f32 finish) — no exactness guarantee, recall is
+        measured by the caller's gate.
       recall_target: recall target for "approx".
       block: column width of the first-level sort for "block".
 
@@ -90,6 +93,18 @@ def smallest_k(
     if method == "block" and k <= block and c > block:
         dists, ids = _fold_topk(dists, ids, k, block)
         c = dists.shape[-1]
+    if method == "bf16" and c > 4 * k and dists.dtype == jnp.float32:
+        # preselect 4k candidates by sorting HALF-WIDTH keys (bf16 compare
+        # is monotone in the f32 values it rounds from), then finish with
+        # an exact f32 top-k over the survivors. Near-exact: a true top-k
+        # member can only be lost if >3k candidates round into the same
+        # bf16 value at the boundary — the recall gate measures it (the
+        # method makes no exactness claim).
+        pre = 4 * k
+        _, pos = jax.lax.top_k(-dists.astype(jnp.bfloat16), pre)
+        dists = jnp.take_along_axis(dists, pos, axis=-1)
+        ids = jnp.take_along_axis(ids, pos, axis=-1)
+        c = pre
     if method == "approx" and c > k:
         # lane-align the reduction input: approx_min_k over a width that is
         # not a multiple of 128 (e.g. the stream schedule's carry‖tile concat,

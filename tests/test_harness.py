@@ -349,3 +349,22 @@ def test_cli_profile_writes_trace(tmp_path):
     # the profiler lays out plugins/profile/<run>/; existence of any file
     # under the dir is the contract
     assert any(p.is_file() for p in prof.rglob("*")), "no trace files written"
+
+    # the wire-format trace parser must read what jax.profiler wrote:
+    # at least one plane with busy categories, and a clean per-file error
+    # (not an abort) on a truncated trace
+    sys.path.insert(0, "/root/repo/scripts")
+    try:
+        import trace_ops
+    finally:
+        sys.path.pop(0)
+    files = trace_ops.find_xplanes(str(prof))
+    assert files, "no .xplane.pb written"
+    report = trace_ops.analyze(trace_ops.parse_xplane(files[0]))
+    assert report, "parser produced no planes"
+    plane = next(iter(report.values()))
+    assert plane["busy_ms_by_category"], plane
+    bad = tmp_path / "bad.xplane.pb"
+    bad.write_bytes(b"\xff\xff\xff")
+    with pytest.raises((ValueError, IndexError)):
+        trace_ops.parse_xplane(str(bad))

@@ -145,6 +145,43 @@ def test_cascade_smallest_k_matches_exact(rng, c, k, max_width):
     np.testing.assert_array_equal(np.asarray(got_i), want_i)
 
 
+def test_bf16_method_recall(rng):
+    """'bf16' preselects with half-width keys then finishes exact — no
+    exactness guarantee, but on well-separated random data it must recover
+    essentially everything (measured recall is the method's contract)."""
+    hits = total = 0
+    for trial in range(5):
+        d = rng.standard_normal((32, 600)).astype(np.float32) * 100.0
+        ids = np.broadcast_to(np.arange(600, dtype=np.int32), (32, 600))
+        got_d, got_i = smallest_k(
+            jnp.asarray(d), jnp.asarray(ids[0]), 8, method="bf16"
+        )
+        want_d, want_i = _np_smallest_k(d, ids, 8)
+        # distances of recovered ids must be the TRUE f32 values, not
+        # bf16-rounded ones: check each returned (id, dist) against the
+        # original matrix
+        gd, gi = np.asarray(got_d), np.asarray(got_i)
+        assert gd.dtype == np.float32
+        np.testing.assert_array_equal(
+            gd, np.take_along_axis(d, gi, axis=1)
+        )
+        for r in range(32):
+            hits += len(set(gi[r]) & set(want_i[r]))
+            total += 8
+    assert hits / total >= 0.999, hits / total
+
+
+def test_bf16_method_small_c_falls_back_exact(rng):
+    d = rng.standard_normal((4, 20)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(20, dtype=np.int32), (4, 20))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 6, method="bf16"
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 6)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
 def test_approx_method_runs_on_cpu(rng):
     d = rng.standard_normal((4, 64)).astype(np.float32)
     got_d, got_i = smallest_k(
