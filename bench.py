@@ -124,6 +124,9 @@ def main() -> int:
         # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
         # its data; the library does not. BENCH_PRECISION overrides.
         matmul_precision=os.environ.get("BENCH_PRECISION") or "high",
+        # BENCH_RING_XFER=bfloat16 halves ICI bytes per ring hop (the knob
+        # only matters for BENCH_BACKEND=ring/ring-overlap)
+        ring_transfer_dtype=os.environ.get("BENCH_RING_XFER") or None,
         # uncentered mode exists because raw MNIST pixels are small integers
         # — exactly representable even in bf16 — where *centered* values lose
         # mantissa bits. The relative zero-exclusion threshold is calibrated

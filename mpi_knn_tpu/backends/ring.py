@@ -90,6 +90,12 @@ def _ring_knn_local(
     # (rank -> rank+1, mpi-knn-parallel_blocking.c:131)
     perm = [(i, (i + 1) % num_dev) for i in range(num_dev)]
 
+    if cfg.ring_transfer_dtype is not None:
+        # circulate the block at the transfer dtype (bf16 halves the bytes
+        # every ppermute moves over ICI); cast ONCE here — rounding does not
+        # compound per hop — and upcast per round inside compute()
+        block = block.astype(jnp.dtype(cfg.ring_transfer_dtype))
+
     q_local, dim = queries.shape
     b = block.shape[0]
     acc = jnp.float64 if queries.dtype == jnp.float64 else jnp.float32
@@ -114,6 +120,7 @@ def _ring_knn_local(
 
     def compute(blk, blk_ids, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
+        blk = blk.astype(queries.dtype)  # no-op unless ring_transfer_dtype
         blk_tiles = blk.reshape(b // c_tile, c_tile, dim)
         blk_id_tiles = blk_ids.reshape(b // c_tile, c_tile)
         blk_sq = (

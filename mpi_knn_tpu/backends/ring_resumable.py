@@ -233,6 +233,14 @@ def all_knn_ring_resumable(
 
     c_sharding = NamedSharding(mesh, P(axis))
     q_sharding = NamedSharding(mesh, _query_spec(q_axis, axis))
+    if cfg.ring_transfer_dtype is not None:
+        # cast BEFORE the round loop so every _ring_one_round call sees the
+        # same block dtype — the in-body cast would otherwise retrace and
+        # recompile the whole sharded round between round 0 (compute dtype)
+        # and round 1 (transfer dtype). Resume reconstructs the block from
+        # the f32 corpus and re-casts here, so the values match a
+        # never-interrupted run exactly (the cast is deterministic).
+        corpus_p = corpus_p.astype(jnp.dtype(cfg.ring_transfer_dtype))
     block = jax.device_put(corpus_p, c_sharding)
     block_ids = jax.device_put(corpus_ids, c_sharding)
     queries_p = jax.device_put(queries_p, q_sharding)

@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default="twolevel",
                    help="serial-core tile merge: stream (carry per tile) or "
                    "twolevel (local top-k per tile + one cascade merge)")
+    k.add_argument("--ring-transfer-dtype", choices=["bfloat16", "float32"],
+                   default=None,
+                   help="dtype of the corpus block while it rotates the "
+                   "ring; bfloat16 halves ICI bytes per hop (cast once, "
+                   "upcast per round — exact on integer-valued data)")
     k.add_argument("--pallas-variant", choices=["tiles", "sweep"],
                    default="tiles",
                    help="pallas backend kernel shape: per-tile top-k + XLA "
@@ -278,6 +283,7 @@ def main(argv=None) -> int:
         topk_method=args.topk_method,
         topk_block=args.topk_block,
         merge_schedule=args.merge_schedule,
+        ring_transfer_dtype=args.ring_transfer_dtype,
         pallas_variant=args.pallas_variant,
         exclude_zero=not args.include_zero_dist,
         exclude_self=not args.include_self,

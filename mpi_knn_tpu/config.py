@@ -98,6 +98,16 @@ class KNNConfig:
     num_classes: int = 10
     mesh_axis: str = "ring"
     num_devices: Optional[int] = None
+    # dtype of the corpus block while it circulates the ring. None = the
+    # compute dtype (no cast). "bfloat16" halves the bytes every ppermute
+    # moves over ICI/DCN (the EQuARX-style compressed-collective idea,
+    # PAPERS.md) at the cost of one rounding of the block values per run
+    # (blocks are cast ONCE before rotation, upcast for each round's
+    # distance compute — error does not compound per hop). On integer-
+    # valued data (raw pixels ≤ 255) the cast is exact; on centered data
+    # it costs about what DEFAULT matmul precision costs (~0.3% recall@10,
+    # BASELINE.md) — the recall gate measures it either way.
+    ring_transfer_dtype: Optional[str] = None
     # pallas backend kernel shape: "tiles" = per-(q,c)-tile local top-k +
     # one XLA cross-tile merge (honors topk_method there); "sweep" = whole
     # corpus swept on the minor grid axis with the carry in VMEM scratch,
@@ -131,6 +141,11 @@ class KNNConfig:
             raise ValueError(
                 f"pallas_variant must be one of {PALLAS_VARIANTS}, got "
                 f"{self.pallas_variant!r}"
+            )
+        if self.ring_transfer_dtype not in (None, "bfloat16", "float32"):
+            raise ValueError(
+                "ring_transfer_dtype must be None, 'bfloat16' or 'float32', "
+                f"got {self.ring_transfer_dtype!r}"
             )
         if self.merge_schedule not in MERGE_SCHEDULES:
             raise ValueError(

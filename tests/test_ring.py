@@ -46,6 +46,35 @@ def test_ring_merge_schedule_parity(rng, schedule):
     assert _as_sets(ring.ids) == _as_sets(serial.ids)
 
 
+def test_ring_bf16_transfer_exact_on_integer_data(rng):
+    """ring_transfer_dtype='bfloat16' halves the bytes per ppermute; on
+    integer-valued data (raw pixels <= 255 are bf16-exact) the results must
+    equal serial EXACTLY. center off so values stay integral."""
+    X = np.rint(rng.random((96, 24)) * 255.0).astype(np.float32)
+    serial = all_knn(X, k=5, backend="serial", center=False, zero_eps=0.5,
+                     query_tile=32, corpus_tile=32)
+    ring = all_knn(X, k=5, backend="ring", center=False, zero_eps=0.5,
+                   ring_transfer_dtype="bfloat16")
+    np.testing.assert_allclose(
+        np.asarray(ring.dists), np.asarray(serial.dists), rtol=1e-6
+    )
+    assert _as_sets(ring.ids) == _as_sets(serial.ids)
+
+
+def test_ring_bf16_transfer_recall_on_float_data(rng):
+    """On non-integer data the one-time bf16 cast of the rotating block may
+    flip near-ties; id-set recall vs serial is the contract (>= 0.99 on
+    well-separated blobs)."""
+    from mpi_knn_tpu.utils.report import recall_at_k
+
+    X = _data(rng, m=128)
+    serial = all_knn(X, k=6, backend="serial", query_tile=32, corpus_tile=32)
+    ring = all_knn(X, k=6, backend="ring-overlap",
+                   ring_transfer_dtype="bfloat16")
+    rec = recall_at_k(np.asarray(ring.ids), np.asarray(serial.ids))
+    assert rec >= 0.99, rec
+
+
 @pytest.mark.parametrize("backend", ["ring", "ring-overlap"])
 def test_ring_non_divisible_m(rng, backend):
     """m=101 is not divisible by P=8 — the reference silently corrupted here

@@ -58,6 +58,26 @@ def test_ring_resumable_fault_injection(rng, tmp_path):
     )
 
 
+def test_ring_resumable_bf16_transfer_resume_identical(rng, tmp_path):
+    """ring_transfer_dtype through the resumable driver: the rotating block
+    changes dtype (reconstructed from the f32 corpus and re-cast on resume),
+    and a killed-then-resumed run must match an uninterrupted one
+    bit-identically."""
+    X = np.rint(rng.random((96, 12)) * 255.0).astype(np.float32)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8,
+                    ring_transfer_dtype="bfloat16")
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck, stop_after_rounds=3
+    )
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck
+    )
+    d0, i0 = all_knn_ring_resumable(X, X, _ids(len(X)), cfg)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d))
+
+
 def test_ring_resumable_2d_mesh(rng, tmp_path):
     X = _data(rng, m=80)
     cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8)
