@@ -1,0 +1,117 @@
+"""Render measurements/r3.jsonl (+ mfu.json / trace_ops jsons when present)
+as BASELINE.md-ready markdown tables on stdout.
+
+Keeps the fold from measurement to document mechanical: run the suite
+(scripts/r3_measure.sh), then `python scripts/fold_r3.py >> notes.md` and
+edit the narrative around the tables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+MDIR = ROOT / "measurements"
+
+
+def rows(path):
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                out.append({"step": "?", "raw": line})
+    return out
+
+
+def main() -> int:
+    r3 = rows(MDIR / "r3.jsonl")
+    if not r3:
+        print(f"no rows in {MDIR}/r3.jsonl", file=sys.stderr)
+        return 1
+
+    bench = [r for r in r3 if r.get("unit") == "s" and "metric" in r]
+    status = [r for r in r3 if "status" in r or "result" in r]
+    other = [r for r in r3 if r not in bench and r not in status]
+
+    if bench:
+        print("### Timed measurements (r3.jsonl)\n")
+        print("| step | metric | value | vs_baseline | extra |")
+        print("|---|---|---|---|---|")
+        for r in bench:
+            extra = {
+                k: v
+                for k, v in r.items()
+                if k not in ("step", "metric", "value", "unit",
+                             "vs_baseline")
+            }
+            print(
+                f"| {r.get('step', '?')} | {r['metric']} | {r['value']} s | "
+                f"{r.get('vs_baseline', '')} | "
+                f"{json.dumps(extra) if extra else ''} |"
+            )
+        print()
+
+    if other:
+        print("### Structured results\n")
+        for r in other:
+            print(f"- `{json.dumps(r)}`")
+        print()
+
+    if status:
+        print("### Step status\n")
+        for r in status:
+            print(f"- {r.get('step', '?')}: "
+                  f"{r.get('status') or r.get('result')}")
+        print()
+
+    mfu = MDIR / "mfu.json"
+    if mfu.exists():
+        m = json.loads(mfu.read_text())
+        print(f"### MFU ({m.get('workload')}, useful "
+              f"{m.get('useful_tflop')} TFLOP, peak "
+              f"{m.get('peak_bf16_tflops')} TF/s bf16)\n")
+        print("| variant | median | MFU vs bf16 peak | pass factor | "
+              "top-k share (est) |")
+        print("|---|---|---|---|---|")
+        for r in m.get("results", []):
+            print(
+                f"| {r['variant']} | {r['median_s']} s | "
+                f"{100 * r.get('mfu_vs_bf16_peak', 0):.2f} % | "
+                f"{r.get('mxu_pass_factor', '')} | "
+                f"{r.get('topk_share_est', '')} |"
+            )
+        print()
+
+    for name in ("trace_ops_r3.json", "trace_ops_ring_ab.json"):
+        p = MDIR / name
+        if not p.exists():
+            continue
+        data = json.loads(p.read_text())
+        print(f"### {name}\n")
+        for f, planes in data.items():
+            if "error" in planes:
+                print(f"- {f}: ERROR {planes['error']}")
+                continue
+            for plane, rep in planes.items():
+                if not plane.lower().startswith(("/device", "/tpu")) and \
+                        "TPU" not in plane:
+                    continue  # host planes are noise for the device story
+                print(f"- **{f}** `{plane}`: busy by category "
+                      f"{rep['busy_ms_by_category']}; collective total "
+                      f"{rep['collective_total_ms']} ms, overlapped with "
+                      f"matmul {rep['collective_overlapped_with_matmul_ms']}"
+                      f" ms")
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
