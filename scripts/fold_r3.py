@@ -9,7 +9,6 @@ edit the narrative around the tables.
 from __future__ import annotations
 
 import json
-import os
 import sys
 from pathlib import Path
 
@@ -37,9 +36,16 @@ def main() -> int:
         print(f"no rows in {MDIR}/r3.jsonl", file=sys.stderr)
         return 1
 
-    bench = [r for r in r3 if r.get("unit") == "s" and "metric" in r]
-    status = [r for r in r3 if "status" in r or "result" in r]
-    other = [r for r in r3 if r not in bench and r not in status]
+    timed = [r for r in r3 if r.get("unit") == "s" and "metric" in r]
+    # watchdog sentinels must not masquerade as measurements
+    bench = [r for r in timed if not r.get("failed")]
+    failed = [r for r in timed if r.get("failed")]
+    status = [r for r in r3 if "status" in r or "result" in r] + [
+        {"step": r.get("step", r.get("metric", "?")),
+         "status": f"WATCHDOG-FAILED at {r['value']} s"}
+        for r in failed
+    ]
+    other = [r for r in r3 if r not in timed and r not in status]
 
     if bench:
         print("### Timed measurements (r3.jsonl)\n")
@@ -74,7 +80,15 @@ def main() -> int:
 
     mfu = MDIR / "mfu.json"
     if mfu.exists():
-        m = json.loads(mfu.read_text())
+        try:
+            m = json.loads(mfu.read_text())
+        except json.JSONDecodeError as e:
+            # a timeout-killed profiler leaves a truncated file; keep folding
+            print(f"### mfu.json: UNPARSEABLE ({e})\n")
+            m = None
+    else:
+        m = None
+    if m:
         print(f"### MFU ({m.get('workload')}, useful "
               f"{m.get('useful_tflop')} TFLOP, peak "
               f"{m.get('peak_bf16_tflops')} TF/s bf16)\n")
@@ -94,7 +108,11 @@ def main() -> int:
         p = MDIR / name
         if not p.exists():
             continue
-        data = json.loads(p.read_text())
+        try:
+            data = json.loads(p.read_text())
+        except json.JSONDecodeError as e:
+            print(f"### {name}: UNPARSEABLE ({e})\n")
+            continue
         print(f"### {name}\n")
         for f, planes in data.items():
             if "error" in planes:

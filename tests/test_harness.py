@@ -275,6 +275,24 @@ def test_cli_entrypoint_subprocess():
     assert r.returncode == 0, r.stderr[-2000:]
 
 
+def test_cli_svd_path(tmp_path):
+    """--svd reduces the corpus on device before the kNN (the
+    mnist_train_svd configuration); the report must carry the svd phase and
+    a sane accuracy (exactly what scripts/r3_measure.sh's svd step
+    extracts)."""
+    rep = tmp_path / "svd.json"
+    rc = cli_main(
+        ["--data", "synthetic:200x32c4", "--k", "5", "--num-classes", "4",
+         "--svd", "8", "--loo", "--platform", "cpu", "-q",
+         "--report", str(rep)]
+    )
+    assert rc == 0
+    body = json.loads(rep.read_text())
+    assert "svd" in body["phase_seconds"] and "knn" in body["phase_seconds"]
+    assert body["accuracy"] is not None and body["accuracy"] > 0.5
+    assert body["shape"] == [200, 8]  # reduced dim reaches the kNN
+
+
 def test_bench_driver_contract():
     """`python bench.py` is THE driver interface: stdout must be exactly one
     JSON line with metric/value/unit/vs_baseline, stderr must carry the
