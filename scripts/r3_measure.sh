@@ -21,6 +21,10 @@ EOF
 
 wait_alive() {
   for i in $(seq 1 "${PROBE_RETRIES:-10}"); do
+    if past_deadline; then
+      echo "probe loop: past deadline, stopping" >&2
+      return 1
+    fi
     probe && return 0
     echo "probe $i: device unresponsive; waiting 120s" >&2
     sleep 120
@@ -30,8 +34,20 @@ wait_alive() {
 
 note() { echo "{\"step\": \"$1\", \"status\": \"$2\", \"ts\": \"$(date -Is)\"}" >> "$OUT"; }
 
+past_deadline() {
+  # DEADLINE_EPOCH: hard stop for STARTING steps — the driver needs the
+  # chip to itself for the end-of-round bench; a measurement suite still
+  # holding the device then would poison the round's headline artifact
+  [ -n "${DEADLINE_EPOCH:-}" ] && [ "$(date +%s)" -gt "$DEADLINE_EPOCH" ]
+}
+
 run_step() { # name timeout_s command...
   local name=$1 tmo=$2; shift 2
+  if past_deadline; then
+    note "$name" "SKIPPED-deadline"
+    echo "== $name: past deadline, yielding the device to the driver" >&2
+    exit 0
+  fi
   if ! wait_alive; then
     # a dead transport will not heal mid-suite; abort instead of burning
     # a 20-minute retry window per remaining step
@@ -50,6 +66,10 @@ run_step() { # name timeout_s command...
 
 run_report_step() { # name timeout_s report_file command...
   local name=$1 tmo=$2 rep=$3; shift 3
+  if past_deadline; then
+    note "$name" "SKIPPED-deadline"
+    exit 0
+  fi
   if ! wait_alive; then
     note "$name" "ABORT-device-dead"
     echo "== $name: device dead, aborting suite" >&2
