@@ -50,10 +50,14 @@ def _kind_for(path: Path) -> str:
         ) from None
 
 
-def read_vecs_native(path, limit: Optional[int] = None) -> Optional[np.ndarray]:
+def read_vecs_native(path, limit: Optional[int] = None,
+                     lib=None) -> Optional[np.ndarray]:
     """Native read; None if the native lib is unavailable. Raises ValueError
-    on malformed files (truncation, inconsistent dims)."""
-    lib = load_native_lib()
+    on malformed files (truncation, inconsistent dims). ``lib`` overrides
+    the default library (the ASan sweep passes the sanitizer build so THIS
+    loop runs under the sanitizer)."""
+    if lib is None:
+        lib = load_native_lib()
     if lib is None:
         return None
     path = Path(path)

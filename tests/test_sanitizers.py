@@ -61,15 +61,13 @@ def test_dtype_sweep_recall(rng, dtype, backend):
     assert rec >= (0.97 if dtype == "bfloat16" else 0.999), rec
 
 
-def test_native_readers_asan_clean_on_genuine_matlab_files():
-    """The C++ MAT parser, built with AddressSanitizer, sweeps every genuine
-    MATLAB-written fixture scipy ships (110 files: v5 it parses, v4/
-    big-endian/object files it must reject) with zero sanitizer aborts —
-    the native-code analog of the Q2 race-tooling the reference lacked.
-    Subprocess: ASan must be LD_PRELOADed before the interpreter starts."""
+def _asan_runtime_or_skip():
+    """Build the sanitizer libs and locate the matching ASan runtime, or
+    skip. The runtime must come from the SAME compiler family the Makefile
+    used ($(CXX)); a gcc-located libasan under a clang-built .so aborts at
+    interceptor init."""
     import os
     import subprocess
-    import sys
 
     mk = subprocess.run(
         ["make", "-C", "native", "asan"], capture_output=True, text=True,
@@ -77,9 +75,6 @@ def test_native_readers_asan_clean_on_genuine_matlab_files():
     )
     if mk.returncode != 0:
         pytest.skip(f"no ASan toolchain: {mk.stderr[-200:]}")
-    # the runtime must come from the SAME compiler family the Makefile used
-    # ($(CXX)); a gcc-located libasan under a clang-built .so aborts at
-    # interceptor init
     cxx = os.environ.get("CXX", "g++")
     if "clang" in cxx:
         locator = [cxx, "-print-file-name=libclang_rt.asan-x86_64.so"]
@@ -97,6 +92,31 @@ def test_native_readers_asan_clean_on_genuine_matlab_files():
         # runtime; LD_PRELOADing that string silently does nothing and the
         # ASan .so then aborts at load — skip instead
         pytest.skip(f"{locator[0]} has no ASan runtime")
+    return libasan
+
+
+def _run_under_asan(code: str, libasan: str):
+    import os
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env=dict(os.environ, LD_PRELOAD=libasan,
+                 ASAN_OPTIONS="detect_leaks=0"),
+        capture_output=True, text=True, cwd="/root/repo", timeout=300,
+    )
+
+
+def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
+    """The C++ MAT parser, built with AddressSanitizer, sweeps every genuine
+    MATLAB-written fixture scipy ships (110 files: v5 it parses, v4/
+    big-endian/object files it must reject) with zero sanitizer aborts —
+    the native-code analog of the Q2 race-tooling the reference lacked.
+    Subprocess: ASan must be LD_PRELOADed before the interpreter starts."""
+    import os
+
+    libasan = _asan_runtime_or_skip()
     data_dir = None
     try:
         import scipy.io as sio
@@ -122,14 +142,57 @@ for f in sorted(glob.glob({data_dir!r} + '/*.mat')):
 print('PARSED', n_ok, 'REJECTED', n_err)
 assert n_ok >= 70 and n_err >= 25
 """
-    r = subprocess.run(
-        [sys.executable, "-c", code],
-        env=dict(os.environ, LD_PRELOAD=libasan,
-                 ASAN_OPTIONS="detect_leaks=0"),
-        capture_output=True, text=True, cwd="/root/repo", timeout=300,
-    )
+    r = _run_under_asan(code, libasan)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "PARSED" in r.stdout
+
+
+def test_native_vecs_reader_asan_clean():
+    """Same sweep for the fvecs/bvecs/ivecs reader: valid files plus
+    truncated/absurd-dim/inconsistent mutants, the PRODUCTION read loop
+    under ASan."""
+    libasan = _asan_runtime_or_skip()
+    vecs_code = """
+import ctypes, struct
+import numpy as np
+from pathlib import Path
+import tempfile
+from mpi_knn_tpu.data.vecs import _bind, read_vecs_native
+lib = ctypes.CDLL('/root/repo/native/build/libtknn_vecsio_asan.so')
+_bind(lib)
+with tempfile.TemporaryDirectory() as td:
+    tmp = Path(td)
+    rng = np.random.default_rng(0)
+    ok = rejected = 0
+    def write(path, arr, comp):
+        with open(path, 'wb') as f:
+            for row in arr:
+                f.write(struct.pack('<i', len(row)))
+                f.write(np.asarray(row, dtype=comp).tobytes())
+    X = rng.standard_normal((40, 12)).astype(np.float32)
+    write(tmp / 'a.fvecs', X, np.float32)
+    write(tmp / 'b.bvecs', (np.abs(X) * 10 % 200), np.uint8)
+    write(tmp / 'c.ivecs', (X * 100), np.int32)
+    for f in ('a.fvecs', 'b.bvecs', 'c.ivecs'):
+        got = read_vecs_native(tmp / f, lib=lib)
+        assert got is not None and got.shape[0] == 40
+        ok += 1
+    # mutants: truncated mid-row, absurd dim, inconsistent dims
+    (tmp / 'trunc.fvecs').write_bytes((tmp / 'a.fvecs').read_bytes()[:-7])
+    (tmp / 'bigdim.fvecs').write_bytes(struct.pack('<i', 1 << 30) + b'xxxx')
+    good = (tmp / 'a.fvecs').read_bytes()
+    (tmp / 'mixed.fvecs').write_bytes(good + struct.pack('<i', 5) + b'\\0' * 20)
+    for f in ('trunc.fvecs', 'bigdim.fvecs', 'mixed.fvecs'):
+        try:
+            read_vecs_native(tmp / f, lib=lib)
+        except ValueError:
+            rejected += 1
+    print('VECS_OK', ok, 'VECS_REJECTED', rejected)
+    assert ok == 3 and rejected == 3
+"""
+    r = _run_under_asan(vecs_code, libasan)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "VECS_OK 3" in r.stdout
 
 
 def test_logs_prefix_and_levels(capsys):
