@@ -218,6 +218,8 @@ def read_mat_native(path, lib=None) -> Dict[str, np.ndarray]:
     (this function) is what runs under the sanitizer."""
     if lib is None:
         lib = load_native_lib()
+    else:
+        _bind(lib)  # idempotent; an unbound CDLL would truncate pointers
     if lib is None:
         raise RuntimeError("native MAT reader unavailable (build failed?)")
     h = lib.tknn_mat_open(str(path).encode())

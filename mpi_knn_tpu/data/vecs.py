@@ -58,6 +58,8 @@ def read_vecs_native(path, limit: Optional[int] = None,
     loop runs under the sanitizer)."""
     if lib is None:
         lib = load_native_lib()
+    else:
+        _bind(lib)  # idempotent; an unbound CDLL would truncate pointers
     if lib is None:
         return None
     path = Path(path)

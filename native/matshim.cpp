@@ -1,0 +1,84 @@
+// Implementation of the mat.h shim (see matshim.h) on top of the
+// framework's MAT v5 reader C API (native/matio.cpp: tknn_mat_*).
+//
+// The reference program opens the file read-only, fetches whole variables,
+// and reads them through mxGetPr as column-major doubles — exactly the
+// contract tknn_mat_read_f64 provides, so the shim is a thin ownership
+// adapter: MATFile wraps the reader handle, mxArray owns a materialized
+// f64 buffer plus its (rows, cols) shape.
+
+#include "matshim.h"
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+extern "C" {
+// native/matio.cpp public API
+void *tknn_mat_open(const char *path);
+int tknn_mat_var_shape(void *h, const char *name, int64_t *dims, int max_dims);
+int64_t tknn_mat_read_f64(void *h, const char *name, double *out);
+void tknn_mat_close(void *h);
+}
+
+struct MATFile {
+  void *handle;
+};
+
+struct mxArray_tag {
+  size_t m;  // rows
+  size_t n;  // cols
+  double *data;  // column-major, owned
+};
+
+extern "C" {
+
+MATFile *matOpen(const char *filename, const char *mode) {
+  (void)mode;  // the shim is read-only; the reference only opens "r"
+  void *h = tknn_mat_open(filename);
+  if (!h) return nullptr;
+  MATFile *f = new (std::nothrow) MATFile{h};
+  if (!f) tknn_mat_close(h);
+  return f;
+}
+
+int matClose(MATFile *pmat) {
+  if (!pmat) return -1;
+  tknn_mat_close(pmat->handle);
+  delete pmat;
+  return 0;
+}
+
+mxArray *matGetVariable(MATFile *pmat, const char *name) {
+  if (!pmat) return nullptr;
+  int64_t dims[8] = {0};
+  int nd = tknn_mat_var_shape(pmat->handle, name, dims, 8);
+  if (nd < 1) return nullptr;
+  size_t rows = static_cast<size_t>(dims[0]);
+  size_t cols = nd >= 2 ? static_cast<size_t>(dims[1]) : 1;
+  for (int i = 2; i < nd; i++) cols *= static_cast<size_t>(dims[i]);
+  size_t count = rows * cols;
+  double *buf = static_cast<double *>(std::malloc(count * sizeof(double)));
+  if (!buf) return nullptr;
+  if (tknn_mat_read_f64(pmat->handle, name, buf) !=
+      static_cast<int64_t>(count)) {
+    std::free(buf);
+    return nullptr;
+  }
+  mxArray *a = new (std::nothrow) mxArray_tag{rows, cols, buf};
+  if (!a) std::free(buf);
+  return a;
+}
+
+size_t mxGetM(const mxArray *pa) { return pa ? pa->m : 0; }
+size_t mxGetN(const mxArray *pa) { return pa ? pa->n : 0; }
+double *mxGetPr(const mxArray *pa) { return pa ? pa->data : nullptr; }
+
+void mxDestroyArray(mxArray *pa) {
+  if (!pa) return;
+  std::free(pa->data);
+  delete pa;
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+/* Clean-room subset of MATLAB's MAT-file C API ("mat.h"), backed by the
+ * framework's own MAT v5 reader (native/matio.cpp) instead of libmat.
+ *
+ * Purpose: compile and run the UNMODIFIED reference program
+ * (/root/reference/knn-serial.c includes "mat.h" and calls matOpen /
+ * matGetVariable / mxGetM / mxGetN / mxGetPr / mxDestroyArray / matClose)
+ * on this host, so BASELINE.md can carry a *measured* number for the
+ * reference's own headline benchmark rather than "not published".
+ *
+ * Only the surface the reference uses is provided; everything returns
+ * double-precision column-major data, which is what mxGetPr yields for
+ * MATLAB double arrays and what the reference's `p[k + j*m]` indexing
+ * assumes. This is measurement tooling, not part of the framework API.
+ */
+#ifndef TKNN_MATSHIM_H_
+#define TKNN_MATSHIM_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct MATFile MATFile;
+typedef struct mxArray_tag mxArray;
+
+MATFile *matOpen(const char *filename, const char *mode);
+int matClose(MATFile *pmat);
+mxArray *matGetVariable(MATFile *pmat, const char *name);
+
+size_t mxGetM(const mxArray *pa);
+size_t mxGetN(const mxArray *pa);
+double *mxGetPr(const mxArray *pa);
+void mxDestroyArray(mxArray *pa);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TKNN_MATSHIM_H_ */

@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Measure the REFERENCE's own serial program on this host.
+
+BASELINE.md's "published reference numbers" section is empty because the
+reference prints its timing at runtime and ships no results. This script
+closes that gap with a measurement: it compiles the UNMODIFIED
+``/root/reference/knn-serial.c`` against the clean-room mat.h shim
+(``native/matshim.{h,cpp}`` over the framework's own MAT v5 reader), feeds
+it the exact corpus ``bench.py`` uses (``make_mnist_like(60000, 784,
+seed=0)``, truncated per size), and records the program's own
+``Clock time = %f`` phase timing (``knn-serial.c:94-98`` — the same phase
+bench.py times) plus its ``Matches`` LOO count.
+
+The reference is O(m^2 d) scalar C on one core, so the full m=60000 run
+takes hours; the default sweep measures smaller sizes and reports the
+quadratic fit alongside any directly measured points. Run with
+``--sizes 60000`` (and a large --timeout) for the direct headline point.
+
+CPU-only by construction: JAX_PLATFORMS=cpu is forced before any import so
+this can run while the TPU is held by the measurement suite.
+
+Output: one JSON line (also appended to --out):
+  {"rows": [{"m":..., "clock_s":..., "matches":...}, ...],
+   "fit_quadratic_60000_s":..., "compiler":...}
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the TPU
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+REF = Path("/root/reference")
+BUILD = REPO / ".refbench"
+CFLAGS = ["-O2", "-fopenmp"]
+
+
+def build_binary() -> Path:
+    """Compile the unmodified reference source against the matshim."""
+    BUILD.mkdir(exist_ok=True)
+    # the reference includes "mat.h"; give it the shim under that name
+    (BUILD / "mat.h").write_bytes((REPO / "native" / "matshim.h").read_bytes())
+    objs = []
+    for src in ("matio.cpp", "matshim.cpp"):
+        obj = BUILD / (src + ".o")
+        subprocess.run(
+            ["g++", *CFLAGS, "-std=c++17", "-I", str(REPO / "native"),
+             "-c", str(REPO / "native" / src), "-o", str(obj)],
+            check=True,
+        )
+        objs.append(str(obj))
+    ser_obj = BUILD / "knn-serial.o"
+    # C, not C++ (the source uses `class` as an identifier); unmodified file
+    subprocess.run(
+        ["gcc", *CFLAGS, "-I", str(BUILD), "-c", str(REF / "knn-serial.c"),
+         "-o", str(ser_obj)],
+        check=True,
+    )
+    binary = BUILD / "knn-serial"
+    subprocess.run(
+        ["g++", *CFLAGS, str(ser_obj), *objs, "-o", str(binary),
+         "-lz", "-lm"],
+        check=True,
+    )
+    return binary
+
+
+def make_workload(m: int, workdir: Path) -> None:
+    """Write mnist_train.mat for the reference: train_X (m×784 f64) +
+    train_labels in 1..10 — the first m rows of bench.py's corpus."""
+    from mpi_knn_tpu.data.synthetic import make_mnist_like
+    from mpi_knn_tpu.data.matfile import write_mat
+
+    X, y = make_mnist_like(60000, 784, seed=0)
+    workdir.mkdir(parents=True, exist_ok=True)
+    write_mat(
+        workdir / "mnist_train.mat",
+        {
+            "train_X": X[:m].astype("float64"),
+            "train_labels": (y[:m] + 1).astype("float64"),
+        },
+        compress=False,  # fast to write, fast to read; size is transient
+    )
+
+
+def run_one(binary: Path, m: int, timeout_s: int) -> dict:
+    workdir = BUILD / f"m{m}"
+    make_workload(m, workdir)
+    t0 = time.time()
+    # unlimited stack: the reference keeps its m×30 neighbour matrix in VLAs
+    proc = subprocess.run(
+        ["bash", "-c", f"ulimit -s unlimited && exec {binary}"],
+        cwd=workdir, capture_output=True, text=True, timeout=timeout_s,
+    )
+    wall = time.time() - t0
+    out = proc.stdout
+    clock = re.search(r"Clock time = ([0-9.]+)", out)
+    matches = re.search(r"Matches: (\d+)", out)
+    row = {
+        "m": m,
+        "d": 784,
+        "clock_s": float(clock.group(1)) if clock else None,
+        "matches": int(matches.group(1)) if matches else None,
+        "wall_s": round(wall, 3),
+        "rc": proc.returncode,
+    }
+    if row["matches"] is not None:
+        row["loo_accuracy"] = row["matches"] / m
+    # reclaim the transient .mat (376 MB at m=60000)
+    (workdir / "mnist_train.mat").unlink(missing_ok=True)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="1000,2000,5000,10000",
+                    help="comma-separated corpus sizes to run")
+    ap.add_argument("--timeout", type=int, default=3600,
+                    help="per-run timeout, seconds")
+    ap.add_argument("--out", default="measurements/ref_serial_cpu.json")
+    args = ap.parse_args()
+
+    binary = build_binary()
+    rows = []
+    for m in [int(s) for s in args.sizes.split(",") if s]:
+        try:
+            row = run_one(binary, m, args.timeout)
+        except subprocess.TimeoutExpired:
+            row = {"m": m, "d": 784, "clock_s": None,
+                   "error": f"timeout>{args.timeout}s"}
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+
+    result = {
+        "what": "reference knn-serial.c, unmodified, via matshim",
+        "host": f"1 CPU core ({os.uname().machine})",
+        "compiler": f"gcc {' '.join(CFLAGS)}",
+        "timed_phase": "the program's own 'Clock time' print "
+                       "(knn-serial.c:94-98): all-kNN only, excludes IO/vote",
+        "rows": rows,
+    }
+    # quadratic extrapolation from the largest measured size: the kernel is
+    # exactly m^2 * d inner iterations, so t ~ a*m^2 at fixed d
+    good = [r for r in rows if r.get("clock_s")]
+    if good:
+        biggest = max(good, key=lambda r: r["m"])
+        a = biggest["clock_s"] / biggest["m"] ** 2
+        result["fit_quadratic_60000_s"] = round(a * 60000**2, 1)
+        result["fit_from_m"] = biggest["m"]
+
+    out = REPO / args.out
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(result, indent=1))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,11 +6,15 @@ inside a jitted computation raises immediately) plus dtype sweeps that pin
 every backend to the serial ground truth.
 """
 
+from pathlib import Path
+
 import jax
 import numpy as np
 import pytest
 
 from mpi_knn_tpu import KNNConfig, all_knn, knn_classify
+
+_REPO = Path(__file__).resolve().parents[1]
 
 
 def _data(rng, m=64, d=12):
@@ -61,17 +65,29 @@ def test_dtype_sweep_recall(rng, dtype, backend):
     assert rec >= (0.97 if dtype == "bfloat16" else 0.999), rec
 
 
-def _asan_runtime_or_skip():
-    """Build the sanitizer libs and locate the matching ASan runtime, or
-    skip. The runtime must come from the SAME compiler family the Makefile
-    used ($(CXX)); a gcc-located libasan under a clang-built .so aborts at
-    interceptor init."""
+_ASAN_MEMO: dict = {}
+
+
+def _asan_runtime_or_skip(so_name: str):
+    """Build ONE sanitizer lib (per-artifact, mirroring data/_native.py:
+    a failure in another library's rule must not block this one) and locate
+    the matching ASan runtime, or skip. The runtime must come from the SAME
+    compiler family the Makefile used ($(CXX)); a gcc-located libasan under
+    a clang-built .so aborts at interceptor init. Memoized: one build +
+    locate per session."""
     import os
     import subprocess
 
+    if so_name in _ASAN_MEMO:
+        result = _ASAN_MEMO[so_name]
+        if result is None:
+            pytest.skip(f"ASan unavailable for {so_name} (memoized)")
+        return result
+
+    _ASAN_MEMO[so_name] = None  # pessimistic until every step succeeds
     mk = subprocess.run(
-        ["make", "-C", "native", "asan"], capture_output=True, text=True,
-        cwd="/root/repo", timeout=120,
+        ["make", "-C", "native", f"build/{so_name}"],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
     )
     if mk.returncode != 0:
         pytest.skip(f"no ASan toolchain: {mk.stderr[-200:]}")
@@ -92,6 +108,7 @@ def _asan_runtime_or_skip():
         # runtime; LD_PRELOADing that string silently does nothing and the
         # ASan .so then aborts at load — skip instead
         pytest.skip(f"{locator[0]} has no ASan runtime")
+    _ASAN_MEMO[so_name] = libasan
     return libasan
 
 
@@ -104,7 +121,7 @@ def _run_under_asan(code: str, libasan: str):
         [sys.executable, "-c", code],
         env=dict(os.environ, LD_PRELOAD=libasan,
                  ASAN_OPTIONS="detect_leaks=0"),
-        capture_output=True, text=True, cwd="/root/repo", timeout=300,
+        capture_output=True, text=True, cwd=_REPO, timeout=300,
     )
 
 
@@ -116,7 +133,7 @@ def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
     Subprocess: ASan must be LD_PRELOADed before the interpreter starts."""
     import os
 
-    libasan = _asan_runtime_or_skip()
+    libasan = _asan_runtime_or_skip("libtknn_matio_asan.so")
     data_dir = None
     try:
         import scipy.io as sio
@@ -129,9 +146,8 @@ def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
         pytest.skip("scipy matlab fixtures unavailable")
     code = f"""
 import ctypes, glob
-from mpi_knn_tpu.data.matfile import _bind, read_mat_native
-lib = ctypes.CDLL('/root/repo/native/build/libtknn_matio_asan.so')
-_bind(lib)
+from mpi_knn_tpu.data.matfile import read_mat_native
+lib = ctypes.CDLL({str(_REPO / 'native/build/libtknn_matio_asan.so')!r})
 n_ok = n_err = 0
 for f in sorted(glob.glob({data_dir!r} + '/*.mat')):
     try:
@@ -151,15 +167,14 @@ def test_native_vecs_reader_asan_clean():
     """Same sweep for the fvecs/bvecs/ivecs reader: valid files plus
     truncated/absurd-dim/inconsistent mutants, the PRODUCTION read loop
     under ASan."""
-    libasan = _asan_runtime_or_skip()
-    vecs_code = """
+    libasan = _asan_runtime_or_skip("libtknn_vecsio_asan.so")
+    vecs_code = f"""
 import ctypes, struct
 import numpy as np
 from pathlib import Path
 import tempfile
-from mpi_knn_tpu.data.vecs import _bind, read_vecs_native
-lib = ctypes.CDLL('/root/repo/native/build/libtknn_vecsio_asan.so')
-_bind(lib)
+from mpi_knn_tpu.data.vecs import read_vecs_native
+lib = ctypes.CDLL({str(_REPO / 'native/build/libtknn_vecsio_asan.so')!r})
 with tempfile.TemporaryDirectory() as td:
     tmp = Path(td)
     rng = np.random.default_rng(0)
