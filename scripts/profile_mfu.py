@@ -81,14 +81,25 @@ def main(argv=None) -> int:
     ap.add_argument("--precision", default=None,
                     choices=[None, "default", "high", "highest"])
     ap.add_argument("--topk", default="exact")
-    ap.add_argument("--variants", default="twolevel,stream")
+    ap.add_argument("--variants", default="dist,twolevel,stream",
+                    help="comma list; 'dist' is the distance-only phase "
+                         "(run it in its own process first: a later variant "
+                         "wedging the device must not take its data down)")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--peak-tflops", type=float, default=None,
                     help="override bf16 peak (default: v5e 197)")
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--append-jsonl", default=None,
+                    help="append each row the moment it is measured — the "
+                         "durable partial-results channel for wedge-prone "
+                         "hardware (the r3 mfu step lost 30 min of rows to "
+                         "an end-of-process-only write)")
     ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
                     default="auto")
+    ap.add_argument("--dist-s", type=float, default=None,
+                    help="distance-only median from a prior process, for "
+                         "topk_share_est when 'dist' is not in --variants")
     args = ap.parse_args(argv)
 
     if args.platform != "auto":
@@ -119,58 +130,72 @@ def main(argv=None) -> int:
 
     results = []
 
-    # ---- distance-only phase (shared by the serial variants): identical
-    # tiling and masking, but the per-tile reduction is a fused min — the
-    # pipeline minus its top-k. cfg only affects tiling/masking here.
-    cfg0 = build_cfg("twolevel", args)
-    q_tile, c_tile = effective_tiles(cfg0, args.m, args.m)
-    q_tiles, qid_tiles, c_tiles, c_ids, _ = prepare_tiles(
-        Xd, Xd, np.arange(args.m, dtype=np.int32), cfg0, q_tile, c_tile
-    )
+    def emit(row):
+        results.append(row)
+        print(json.dumps(row), flush=True)
+        if args.append_jsonl:
+            with open(args.append_jsonl, "a") as f:
+                f.write(json.dumps(row) + "\n")
 
-    import functools
+    variants = [v for v in args.variants.split(",") if v]
 
-    @functools.partial(jax.jit, static_argnames=("cfg",))
-    def distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg):
-        c_sq = jax.vmap(sq_norms)(c_tiles)
+    # ---- distance-only pseudo-variant: identical tiling and masking, but
+    # the per-tile reduction is a fused min — the pipeline minus its top-k.
+    # Prior dist_s from an earlier process can be passed via --dist-s so the
+    # per-variant processes still report topk_share_est.
+    dist_s = args.dist_s
+    if "dist" in variants:
+        cfg0 = build_cfg("twolevel", args)
+        q_tile, c_tile = effective_tiles(cfg0, args.m, args.m)
+        q_tiles, qid_tiles, c_tiles, c_ids, _ = prepare_tiles(
+            Xd, Xd, np.arange(args.m, dtype=np.int32), cfg0, q_tile, c_tile
+        )
 
-        def per_qt(argsq):
-            q_x, q_ids = argsq
-            q_sq = sq_norms(q_x)
+        import functools
 
-            def step(_, tile):
-                blk, blk_ids, blk_sq = tile
-                dmin = jnp.min(
-                    masked_dist_tile(
-                        q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg
-                    ),
-                    axis=-1,
-                )
-                return None, dmin
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg):
+            c_sq = jax.vmap(sq_norms)(c_tiles)
 
-            _, mins = jax.lax.scan(step, None, (c_tiles, c_ids, c_sq))
-            return jnp.min(mins, axis=0)
+            def per_qt(argsq):
+                q_x, q_ids = argsq
+                q_sq = sq_norms(q_x)
 
-        return jax.lax.map(per_qt, (q_tiles, qid_tiles))
+                def step(_, tile):
+                    blk, blk_ids, blk_sq = tile
+                    dmin = jnp.min(
+                        masked_dist_tile(
+                            q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg
+                        ),
+                        axis=-1,
+                    )
+                    return None, dmin
 
-    def run_dist():
-        distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg0)
+                _, mins = jax.lax.scan(step, None, (c_tiles, c_ids, c_sq))
+                return jnp.min(mins, axis=0)
 
-    def sync_dist():
-        device_sync(distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg0))
+            return jax.lax.map(per_qt, (q_tiles, qid_tiles))
 
-    dist_times = time_reps(run_dist, sync_dist, args.reps)
-    dist_s = float(np.median(dist_times))
-    results.append(
-        {
-            "variant": "distance-only",
-            "median_s": round(dist_s, 4),
-            "times": [round(t, 4) for t in dist_times],
-            "mfu_vs_bf16_peak": round(useful_flop / dist_s / peak, 4),
-        }
-    )
+        def run_dist():
+            distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg0)
 
-    for variant in [v for v in args.variants.split(",") if v]:
+        def sync_dist():
+            device_sync(
+                distances_only(q_tiles, qid_tiles, c_tiles, c_ids, cfg0)
+            )
+
+        dist_times = time_reps(run_dist, sync_dist, args.reps)
+        dist_s = float(np.median(dist_times))
+        emit(
+            {
+                "variant": "distance-only",
+                "median_s": round(dist_s, 4),
+                "times": [round(t, 4) for t in dist_times],
+                "mfu_vs_bf16_peak": round(useful_flop / dist_s / peak, 4),
+            }
+        )
+
+    for variant in [v for v in variants if v != "dist"]:
         cfg = build_cfg(variant, args)
 
         holder = {}
@@ -191,16 +216,16 @@ def main(argv=None) -> int:
             "mfu_vs_bf16_peak": round(useful_flop / med / peak, 4),
             "precision": prec,
             "mxu_pass_factor": PASS_FACTOR.get(prec, 1.0),
-            "topk_share_est": round(max(0.0, 1.0 - dist_s / med), 3),
         }
+        if dist_s is not None:
+            row["topk_share_est"] = round(max(0.0, 1.0 - dist_s / med), 3)
         if args.profile_dir:
             tdir = str(Path(args.profile_dir) / variant)
             with jax.profiler.trace(tdir):
                 run()
                 sync()
             row["trace_dir"] = tdir
-        results.append(row)
-        print(json.dumps(row), flush=True)
+        emit(row)
 
     summary = {
         "workload": f"all-kNN m={args.m} d={args.d} k={args.k}",

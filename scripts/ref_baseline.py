@@ -97,11 +97,17 @@ def run_one(binary: Path, m: int, timeout_s: int) -> dict:
     workdir = BUILD / f"m{m}"
     make_workload(m, workdir)
     t0 = time.time()
-    # unlimited stack: the reference keeps its m×30 neighbour matrix in VLAs
-    proc = subprocess.run(
-        ["bash", "-c", f"ulimit -s unlimited && exec {binary}"],
-        cwd=workdir, capture_output=True, text=True, timeout=timeout_s,
-    )
+    try:
+        # unlimited stack: the reference keeps its m×30 neighbour matrix
+        # in VLAs
+        proc = subprocess.run(
+            ["bash", "-c", f"ulimit -s unlimited && exec {binary}"],
+            cwd=workdir, capture_output=True, text=True, timeout=timeout_s,
+        )
+    finally:
+        # reclaim the transient .mat (376 MB at m=60000) even on timeout —
+        # the expected failure mode at exactly the sizes where it is big
+        (workdir / "mnist_train.mat").unlink(missing_ok=True)
     wall = time.time() - t0
     out = proc.stdout
     clock = re.search(r"Clock time = ([0-9.]+)", out)
@@ -116,8 +122,6 @@ def run_one(binary: Path, m: int, timeout_s: int) -> dict:
     }
     if row["matches"] is not None:
         row["loo_accuracy"] = row["matches"] / m
-    # reclaim the transient .mat (376 MB at m=60000)
-    (workdir / "mnist_train.mat").unlink(missing_ok=True)
     return row
 
 

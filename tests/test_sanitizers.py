@@ -65,26 +65,15 @@ def test_dtype_sweep_recall(rng, dtype, backend):
     assert rec >= (0.97 if dtype == "bfloat16" else 0.999), rec
 
 
-_ASAN_MEMO: dict = {}
-
-
 def _asan_runtime_or_skip(so_name: str):
     """Build ONE sanitizer lib (per-artifact, mirroring data/_native.py:
     a failure in another library's rule must not block this one) and locate
     the matching ASan runtime, or skip. The runtime must come from the SAME
     compiler family the Makefile used ($(CXX)); a gcc-located libasan under
-    a clang-built .so aborts at interceptor init. Memoized: one build +
-    locate per session."""
+    a clang-built .so aborts at interceptor init."""
     import os
     import subprocess
 
-    if so_name in _ASAN_MEMO:
-        result = _ASAN_MEMO[so_name]
-        if result is None:
-            pytest.skip(f"ASan unavailable for {so_name} (memoized)")
-        return result
-
-    _ASAN_MEMO[so_name] = None  # pessimistic until every step succeeds
     mk = subprocess.run(
         ["make", "-C", "native", f"build/{so_name}"],
         capture_output=True, text=True, cwd=_REPO, timeout=120,
@@ -108,7 +97,6 @@ def _asan_runtime_or_skip(so_name: str):
         # runtime; LD_PRELOADing that string silently does nothing and the
         # ASan .so then aborts at load — skip instead
         pytest.skip(f"{locator[0]} has no ASan runtime")
-    _ASAN_MEMO[so_name] = libasan
     return libasan
 
 
