@@ -17,6 +17,7 @@
 extern "C" {
 // native/matio.cpp public API
 void *tknn_mat_open(const char *path);
+const char *tknn_mat_error(void *h);
 int tknn_mat_var_shape(void *h, const char *name, int64_t *dims, int max_dims);
 int64_t tknn_mat_read_f64(void *h, const char *name, double *out);
 void tknn_mat_close(void *h);
@@ -38,6 +39,14 @@ MATFile *matOpen(const char *filename, const char *mode) {
   (void)mode;  // the shim is read-only; the reference only opens "r"
   void *h = tknn_mat_open(filename);
   if (!h) return nullptr;
+  // the reader signals missing/corrupt files via its error channel, not a
+  // null handle — a swallowed open error here would let the reference run
+  // over zero variables and record "Clock time = 0" as a real measurement
+  const char *err = tknn_mat_error(h);
+  if (err && err[0]) {
+    tknn_mat_close(h);
+    return nullptr;
+  }
   MATFile *f = new (std::nothrow) MATFile{h};
   if (!f) tknn_mat_close(h);
   return f;

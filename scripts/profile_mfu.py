@@ -130,8 +130,10 @@ def main(argv=None) -> int:
 
     results = []
 
-    def emit(row):
-        results.append(row)
+    def emit(row, final=True):
+        row = {**row, "ts": round(time.time(), 1)}  # rows outlive re-runs;
+        if final:                                   # the stamp dates them
+            results.append(row)
         print(json.dumps(row), flush=True)
         if args.append_jsonl:
             with open(args.append_jsonl, "a") as f:
@@ -220,6 +222,11 @@ def main(argv=None) -> int:
         if dist_s is not None:
             row["topk_share_est"] = round(max(0.0, 1.0 - dist_s / med), 3)
         if args.profile_dir:
+            # emit to the durable channel BEFORE the trace capture: if the
+            # profiler wedges the device, the timed numbers must survive it.
+            # The post-trace emit re-writes the row with trace_dir (fold_r3
+            # keeps the last row per variant).
+            emit(dict(row), final=False)
             tdir = str(Path(args.profile_dir) / variant)
             with jax.profiler.trace(tdir):
                 run()

@@ -97,11 +97,16 @@ MFU_ROWS=measurements/mfu_rows.jsonl
 
 dist_s_flag() {  # "--dist-s X" when mfu_dist has landed a row; else empty
   [ -f "$MFU_ROWS" ] || return 0
-  python - <<'EOF' 2>/dev/null
-import json
-rows = [json.loads(l) for l in open("measurements/mfu_rows.jsonl")
-        if l.strip()]
-d = [r for r in rows if r.get("variant") == "distance-only"]
+  MFU_ROWS="$MFU_ROWS" python - <<'EOF' 2>/dev/null
+import json, os
+d = []
+for l in open(os.environ["MFU_ROWS"]):
+    try:  # a wedge-killed writer can leave a torn last line
+        r = json.loads(l)
+    except json.JSONDecodeError:
+        continue
+    if r.get("variant") == "distance-only":
+        d.append(r)
 if d:
     print(f"--dist-s {d[-1]['median_s']}")
 EOF
@@ -129,7 +134,10 @@ bf16topk)  # half-width-key preselect + exact f32 finish; gate measures recall
 bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
   BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_DTYPE=bfloat16 BENCH_CENTER=0 \
   BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-bf16-uncentered 300 python bench.py ;;
-mfu_dist)  # distance-only phase, own process — later variants can't lose it
+mfu_dist)  # distance-only phase, own process — later variants can't lose it.
+  # mfu_dist is the canonical first MFU step: starting it invalidates any
+  # prior round's rows (stale artifacts must not resurface as current)
+  rm -f "$MFU_ROWS"
   run_step mfu-dist 600 python scripts/profile_mfu.py \
     --variants dist --precision high --append-jsonl "$MFU_ROWS"
   ;;

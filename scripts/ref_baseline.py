@@ -75,13 +75,11 @@ def build_binary() -> Path:
     return binary
 
 
-def make_workload(m: int, workdir: Path) -> None:
+def make_workload(m: int, workdir: Path, X, y) -> None:
     """Write mnist_train.mat for the reference: train_X (m×784 f64) +
     train_labels in 1..10 — the first m rows of bench.py's corpus."""
-    from mpi_knn_tpu.data.synthetic import make_mnist_like
     from mpi_knn_tpu.data.matfile import write_mat
 
-    X, y = make_mnist_like(60000, 784, seed=0)
     workdir.mkdir(parents=True, exist_ok=True)
     write_mat(
         workdir / "mnist_train.mat",
@@ -93,9 +91,9 @@ def make_workload(m: int, workdir: Path) -> None:
     )
 
 
-def run_one(binary: Path, m: int, timeout_s: int) -> dict:
+def run_one(binary: Path, m: int, timeout_s: int, X, y) -> dict:
     workdir = BUILD / f"m{m}"
-    make_workload(m, workdir)
+    make_workload(m, workdir, X, y)
     t0 = time.time()
     try:
         # unlimited stack: the reference keeps its m×30 neighbour matrix
@@ -120,6 +118,10 @@ def run_one(binary: Path, m: int, timeout_s: int) -> dict:
         "wall_s": round(wall, 3),
         "rc": proc.returncode,
     }
+    if not row["clock_s"]:
+        # a zero/absent clock means the workload never loaded (the reference
+        # checks nothing and happily times an empty loop) — not a measurement
+        row["error"] = "zero or missing clock — workload not loaded?"
     if row["matches"] is not None:
         row["loo_accuracy"] = row["matches"] / m
     return row
@@ -135,10 +137,13 @@ def main() -> int:
     args = ap.parse_args()
 
     binary = build_binary()
+    from mpi_knn_tpu.data.synthetic import make_mnist_like
+
+    X, y = make_mnist_like(60000, 784, seed=0)  # one generation, all sizes
     rows = []
     for m in [int(s) for s in args.sizes.split(",") if s]:
         try:
-            row = run_one(binary, m, args.timeout)
+            row = run_one(binary, m, args.timeout, X, y)
         except subprocess.TimeoutExpired:
             row = {"m": m, "d": 784, "clock_s": None,
                    "error": f"timeout>{args.timeout}s"}
@@ -155,7 +160,7 @@ def main() -> int:
     }
     # quadratic extrapolation from the largest measured size: the kernel is
     # exactly m^2 * d inner iterations, so t ~ a*m^2 at fixed d
-    good = [r for r in rows if r.get("clock_s")]
+    good = [r for r in rows if r.get("clock_s") and not r.get("error")]
     if good:
         biggest = max(good, key=lambda r: r["m"])
         a = biggest["clock_s"] / biggest["m"] ** 2
