@@ -38,10 +38,10 @@ extern "C" {
 MATFile *matOpen(const char *filename, const char *mode) {
   (void)mode;  // the shim is read-only; the reference only opens "r"
   void *h = tknn_mat_open(filename);
-  if (!h) return nullptr;
-  // the reader signals missing/corrupt files via its error channel, not a
-  // null handle — a swallowed open error here would let the reference run
-  // over zero variables and record "Clock time = 0" as a real measurement
+  if (!h) return nullptr;  // defensive: the current reader never returns
+  // null — it signals missing/corrupt files via its error channel, which
+  // must be consulted here or the reference would happily run over zero
+  // variables and record "Clock time = 0" as a real measurement
   const char *err = tknn_mat_error(h);
   if (err && err[0]) {
     tknn_mat_close(h);

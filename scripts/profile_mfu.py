@@ -95,6 +95,11 @@ def main(argv=None) -> int:
                          "durable partial-results channel for wedge-prone "
                          "hardware (the r3 mfu step lost 30 min of rows to "
                          "an end-of-process-only write)")
+    ap.add_argument("--fresh-jsonl", action="store_true",
+                    help="truncate --append-jsonl at start: this run begins "
+                         "a new measurement epoch (done here, not by the "
+                         "caller, so a suite step that never starts cannot "
+                         "destroy the prior epoch's rows)")
     ap.add_argument("--platform", choices=["auto", "cpu", "tpu"],
                     default="auto")
     ap.add_argument("--dist-s", type=float, default=None,
@@ -129,6 +134,9 @@ def main(argv=None) -> int:
     useful_flop = 2.0 * args.m * args.m * args.d
 
     results = []
+
+    if args.fresh_jsonl and args.append_jsonl:
+        open(args.append_jsonl, "w").close()
 
     def emit(row, final=True):
         row = {**row, "ts": round(time.time(), 1)}  # rows outlive re-runs;

@@ -135,11 +135,11 @@ bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
   BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_DTYPE=bfloat16 BENCH_CENTER=0 \
   BENCH_CT=8192 BENCH_WATCHDOG_S=240 run_step bench-bf16-uncentered 300 python bench.py ;;
 mfu_dist)  # distance-only phase, own process — later variants can't lose it.
-  # mfu_dist is the canonical first MFU step: starting it invalidates any
-  # prior round's rows (stale artifacts must not resurface as current)
-  rm -f "$MFU_ROWS"
+  # mfu_dist is the canonical first MFU step: --fresh-jsonl makes the
+  # profiler itself truncate the rows file at start, so a step skipped by
+  # the deadline/liveness guards cannot destroy the prior epoch's rows
   run_step mfu-dist 600 python scripts/profile_mfu.py \
-    --variants dist --precision high --append-jsonl "$MFU_ROWS"
+    --variants dist --precision high --append-jsonl "$MFU_ROWS" --fresh-jsonl
   ;;
 mfu_twolevel)
   rm -rf profiles/r3/twolevel
