@@ -1,0 +1,259 @@
+// Implementation of the mpi.h shim (see mpishim.h): single-host message
+// passing over named FIFOs, one OS process per rank.
+//
+// Transport layout (created by the launcher under $TKNN_MPI_DIR):
+//   ch_<src>_<dst>   data channel, framed [uint64 nbytes][payload]
+//   bar_up_<i>       rank i -> rank 0 barrier token (1 byte)
+//   bar_dn_<i>       rank 0 -> rank i barrier release (1 byte)
+//
+// Semantics notes, matched to what the reference programs rely on:
+// - Recv's count is a MAXIMUM: the actual delivered size is the sender's
+//   message size (the blocking reference's first exchange receives an
+//   (n+2)-count into a buffer fed by an n-count send — real MPI permits
+//   that, so the shim must too).
+// - FIFO opens block until the peer opens the other end; the reference's
+//   role-ordered ladder (rank 0 Recv->Send, last rank Send->Recv) forms a
+//   sequential chain, so lazy opens cannot deadlock. Writes of messages
+//   larger than the pipe buffer block until the receiver drains —
+//   rendezvous-like, which that ladder also tolerates.
+// - Isend/Irecv run the blocking op on a detached pthread; Wait joins it.
+//   The reference keeps at most one outstanding request of each kind.
+
+#include "mpishim.h"
+
+#include <cerrno>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+int g_rank = -1;
+int g_size = 0;
+std::string g_dir;
+// fd caches, indexed by peer rank
+std::vector<int> g_send_fds, g_recv_fds;
+
+[[noreturn]] void die(const char *what) {
+  fprintf(stderr, "[mpishim rank %d] fatal: %s\n", g_rank, what);
+  exit(70);
+}
+
+int open_checked(const std::string &path, int flags) {
+  int fd;
+  do {
+    fd = open(path.c_str(), flags);
+  } while (fd < 0 && errno == EINTR);
+  if (fd < 0) die(path.c_str());
+  return fd;
+}
+
+void write_all(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      die("write");
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void read_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      die("read");
+    }
+    if (r == 0) die("peer closed channel mid-message");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+int send_fd(int dest) {
+  if (dest < 0 || dest >= g_size) die("send: bad dest rank");
+  if (g_send_fds[dest] < 0)
+    g_send_fds[dest] = open_checked(
+        g_dir + "/ch_" + std::to_string(g_rank) + "_" + std::to_string(dest),
+        O_WRONLY);
+  return g_send_fds[dest];
+}
+
+int recv_fd(int source) {
+  if (source < 0 || source >= g_size) die("recv: bad source rank");
+  if (g_recv_fds[source] < 0)
+    g_recv_fds[source] = open_checked(
+        g_dir + "/ch_" + std::to_string(source) + "_" + std::to_string(g_rank),
+        O_RDONLY);
+  return g_recv_fds[source];
+}
+
+void do_send(const void *buf, size_t nbytes, int dest) {
+  int fd = send_fd(dest);
+  uint64_t hdr = nbytes;
+  write_all(fd, &hdr, sizeof(hdr));
+  write_all(fd, buf, nbytes);
+}
+
+// Returns the delivered byte count (<= cap).
+size_t do_recv(void *buf, size_t cap, int source) {
+  int fd = recv_fd(source);
+  uint64_t hdr = 0;
+  read_all(fd, &hdr, sizeof(hdr));
+  if (hdr > cap) die("recv: message larger than receive buffer");
+  read_all(fd, buf, hdr);
+  return hdr;
+}
+
+struct ReqArgs {
+  const void *sbuf;
+  void *rbuf;
+  size_t nbytes;
+  int peer;
+  bool is_send;
+};
+
+void *req_main(void *arg) {
+  ReqArgs *a = static_cast<ReqArgs *>(arg);
+  if (a->is_send)
+    do_send(a->sbuf, a->nbytes, a->peer);
+  else
+    do_recv(a->rbuf, a->nbytes, a->peer);
+  return nullptr;
+}
+
+}  // namespace
+
+struct TknnMpiReq {
+  pthread_t thread;
+  ReqArgs args;
+  int peer;
+};
+
+extern "C" {
+
+int MPI_Init(int *, char ***) {
+  const char *r = getenv("TKNN_MPI_RANK");
+  const char *s = getenv("TKNN_MPI_SIZE");
+  const char *d = getenv("TKNN_MPI_DIR");
+  if (!r || !s || !d)
+    die("TKNN_MPI_RANK/SIZE/DIR unset (launch via ref_mpi_baseline.py)");
+  g_rank = atoi(r);
+  g_size = atoi(s);
+  g_dir = d;
+  if (g_size < 1 || g_rank < 0 || g_rank >= g_size) die("bad rank/size");
+  g_send_fds.assign(g_size, -1);
+  g_recv_fds.assign(g_size, -1);
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+  for (int fd : g_send_fds)
+    if (fd >= 0) close(fd);
+  for (int fd : g_recv_fds)
+    if (fd >= 0) close(fd);
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int *size) {
+  *size = g_size;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm, int *rank) {
+  *rank = g_rank;
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm) {
+  unsigned char tok = 1;
+  if (g_size == 1) return MPI_SUCCESS;
+  if (g_rank == 0) {
+    for (int i = 1; i < g_size; i++) {
+      int fd = open_checked(g_dir + "/bar_up_" + std::to_string(i), O_RDONLY);
+      read_all(fd, &tok, 1);
+      close(fd);
+    }
+    for (int i = 1; i < g_size; i++) {
+      int fd = open_checked(g_dir + "/bar_dn_" + std::to_string(i), O_WRONLY);
+      write_all(fd, &tok, 1);
+      close(fd);
+    }
+  } else {
+    int fd = open_checked(g_dir + "/bar_up_" + std::to_string(g_rank),
+                          O_WRONLY);
+    write_all(fd, &tok, 1);
+    close(fd);
+    fd = open_checked(g_dir + "/bar_dn_" + std::to_string(g_rank), O_RDONLY);
+    read_all(fd, &tok, 1);
+    close(fd);
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int,
+             MPI_Comm) {
+  do_send(buf, static_cast<size_t>(count) * dt, dest);
+  return MPI_SUCCESS;
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int,
+             MPI_Comm, MPI_Status *status) {
+  size_t got = do_recv(buf, static_cast<size_t>(count) * dt, source);
+  if (status) {
+    status->MPI_SOURCE = source;
+    status->MPI_TAG = 0;
+    status->MPI_ERROR = static_cast<int>(got);  // byte count, for debugging
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int,
+              MPI_Comm, MPI_Request *request) {
+  TknnMpiReq *req = new TknnMpiReq();
+  req->args = {buf, nullptr, static_cast<size_t>(count) * dt, dest, true};
+  req->peer = dest;
+  if (pthread_create(&req->thread, nullptr, req_main, &req->args))
+    die("pthread_create");
+  *request = req;
+  return MPI_SUCCESS;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int,
+              MPI_Comm, MPI_Request *request) {
+  TknnMpiReq *req = new TknnMpiReq();
+  req->args = {nullptr, buf, static_cast<size_t>(count) * dt, source, false};
+  req->peer = source;
+  if (pthread_create(&req->thread, nullptr, req_main, &req->args))
+    die("pthread_create");
+  *request = req;
+  return MPI_SUCCESS;
+}
+
+int MPI_Wait(MPI_Request *request, MPI_Status *status) {
+  if (!request || !*request) return MPI_SUCCESS;
+  TknnMpiReq *req = *request;
+  if (pthread_join(req->thread, nullptr)) die("pthread_join");
+  if (status) {
+    status->MPI_SOURCE = req->peer;
+    status->MPI_TAG = 0;
+    status->MPI_ERROR = 0;
+  }
+  delete req;
+  *request = nullptr;
+  return MPI_SUCCESS;
+}
+
+}  // extern "C"

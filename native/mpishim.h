@@ -1,0 +1,60 @@
+/* Clean-room subset of the MPI C API ("mpi.h"), backed by named FIFOs
+ * between single-host processes instead of a real MPI runtime.
+ *
+ * Purpose: compile and run the UNMODIFIED reference MPI programs
+ * (/root/reference/mpi-knn-parallel_{blocking,non_blocking}.c) on this
+ * host, so BASELINE.md can carry *measured* numbers for the reference's
+ * two distributed headline benchmarks (it published none), and so the
+ * SURVEY Q1/Q2 bug analysis can be confirmed empirically against the
+ * reference's own compiled code (e.g. under AddressSanitizer).
+ *
+ * Only the surface those two programs use is provided: COMM_WORLD,
+ * doubles, blocking Send/Recv, Isend/Irecv/Wait, Barrier. The process
+ * model is one OS process per rank, launched by scripts/ref_mpi_baseline.py
+ * with TKNN_MPI_RANK / TKNN_MPI_SIZE / TKNN_MPI_DIR in the environment.
+ * This is measurement tooling, not part of the framework API (the
+ * framework's distributed backend is XLA collectives — backends/ring.py).
+ */
+#ifndef TKNN_MPISHIM_H_
+#define TKNN_MPISHIM_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+} MPI_Status;
+
+typedef struct TknnMpiReq *MPI_Request;  /* opaque; filled by Isend/Irecv */
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+
+#define MPI_COMM_WORLD 0
+#define MPI_DOUBLE 8 /* encodes the element size in bytes */
+#define MPI_ANY_TAG (-1)
+#define MPI_SUCCESS 0
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Finalize(void);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+             MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+              MPI_Comm comm, MPI_Request *request);
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *request);
+int MPI_Wait(MPI_Request *request, MPI_Status *status);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TKNN_MPISHIM_H_ */

@@ -107,6 +107,11 @@ def main(argv=None) -> int:
                          "topk_share_est when 'dist' is not in --variants")
     args = ap.parse_args(argv)
 
+    if args.fresh_jsonl and args.append_jsonl:
+        # truncate BEFORE any JAX/device work: a wedge during device init
+        # must not leave the prior epoch's rows posing as this epoch's
+        open(args.append_jsonl, "w").close()
+
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
 
@@ -134,9 +139,6 @@ def main(argv=None) -> int:
     useful_flop = 2.0 * args.m * args.m * args.d
 
     results = []
-
-    if args.fresh_jsonl and args.append_jsonl:
-        open(args.append_jsonl, "w").close()
 
     def emit(row, final=True):
         row = {**row, "ts": round(time.time(), 1)}  # rows outlive re-runs;

@@ -1,0 +1,241 @@
+#!/usr/bin/env python3
+"""Measure the REFERENCE's two MPI programs on this host.
+
+Companion to scripts/ref_baseline.py (which measures knn-serial.c): the
+UNMODIFIED ``/root/reference/mpi-knn-parallel_{blocking,non_blocking}.c``
+are compiled against BOTH clean-room shims — mat.h (native/matshim) for
+their libmat calls and mpi.h (native/mpishim: named-FIFO message passing,
+one OS process per rank) for their MPI calls — then launched with N rank
+processes on the bench.py corpus and their own printed timing recorded
+(rank 0's ``KNN time`` print, blocking:273 / non_blocking:292 — the same
+all-kNN phase the serial program times).
+
+Why this matters: BASELINE.json lists the blocking and non-blocking rings
+among the reference's headline configs, with no published numbers. This
+produces measured ones — and, run with ``--asan``, empirically tests the
+SURVEY §5 Q1 analysis (the ring-rotation/first-exchange bugs feed
+uninitialized id/label columns into the vote, which indexes
+``class[label-1]`` out of bounds for garbage labels).
+
+CPU-only by construction (JAX is never touched); safe to run while the
+TPU is held by the measurement suite.
+
+Output: one JSON object; rows look like
+  {"variant": "blocking", "m":..., "procs":..., "knn_time_s":...,
+   "matches_total":..., "serial_matches":..., "rc": [...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from scripts.ref_baseline import BUILD, CFLAGS, REF, make_workload  # noqa: E402
+
+SOURCES = {
+    "blocking": "mpi-knn-parallel_blocking.c",
+    "non_blocking": "mpi-knn-parallel_non_blocking.c",
+}
+
+
+def build_mpi_binaries(asan: bool = False) -> dict:
+    """Compile both unmodified MPI reference programs against the shims."""
+    BUILD.mkdir(exist_ok=True)
+    (BUILD / "mat.h").write_bytes((REPO / "native" / "matshim.h").read_bytes())
+    (BUILD / "mpi.h").write_bytes(
+        (REPO / "native" / "mpishim.h").read_bytes()
+    )
+    extra = ["-fsanitize=address", "-g"] if asan else []
+    tag = "_asan" if asan else ""
+    objs = []
+    for src in ("matio.cpp", "matshim.cpp", "mpishim.cpp"):
+        obj = BUILD / (src + tag + ".o")
+        subprocess.run(
+            ["g++", *CFLAGS, *extra, "-std=c++17", "-I",
+             str(REPO / "native"), "-c", str(REPO / "native" / src),
+             "-o", str(obj)],
+            check=True,
+        )
+        objs.append(str(obj))
+    out = {}
+    for variant, src in SOURCES.items():
+        obj = BUILD / (src + tag + ".o")
+        subprocess.run(
+            ["gcc", *CFLAGS, *extra, "-I", str(BUILD), "-c",
+             str(REF / src), "-o", str(obj)],
+            check=True,
+        )
+        binary = BUILD / f"knn-{variant}{tag}"
+        subprocess.run(
+            ["g++", *CFLAGS, *extra, str(obj), *objs, "-o", str(binary),
+             "-lz", "-lm", "-lpthread"],
+            check=True,
+        )
+        out[variant] = binary
+    return out
+
+
+def _mkfifos(chdir: Path, procs: int) -> None:
+    chdir.mkdir(parents=True, exist_ok=True)
+    for i in range(procs):
+        for j in range(procs):
+            if i != j:
+                os.mkfifo(chdir / f"ch_{i}_{j}")
+        if i:
+            os.mkfifo(chdir / f"bar_up_{i}")
+            os.mkfifo(chdir / f"bar_dn_{i}")
+
+
+def run_mpi(binary: Path, m: int, procs: int, threads: int, X, y,
+            timeout_s: int, asan: bool = False) -> dict:
+    """Launch one rank process per MPI rank; parse their printed results."""
+    workdir = BUILD / f"mpi_m{m}_p{procs}{'_asan' if asan else ''}"
+    make_workload(m, workdir, X, y)
+    import shutil
+
+    chdir = workdir / "chans"
+    shutil.rmtree(chdir, ignore_errors=True)
+    _mkfifos(chdir, procs)
+
+    env = dict(os.environ, TKNN_MPI_SIZE=str(procs),
+               TKNN_MPI_DIR=str(chdir))
+    if asan:
+        env["ASAN_OPTIONS"] = "detect_leaks=0:exitcode=99"
+    t0 = time.time()
+    ranks = []
+    try:
+        for r in range(procs):
+            ranks.append(subprocess.Popen(
+                [str(binary), str(procs), str(threads)],
+                cwd=workdir, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env={**env, "TKNN_MPI_RANK": str(r)},
+            ))
+        outs = []
+        deadline = t0 + timeout_s
+        for p in ranks:
+            left = max(1.0, deadline - time.time())
+            out, err = p.communicate(timeout=left)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        partial = []
+        for r, p in enumerate(ranks):
+            p.kill()
+            out, err = p.communicate()  # reap; keep diagnostics
+            partial.append(f"rank{r} rc={p.returncode} "
+                           f"out={out[-120:]!r} err={err[-120:]!r}")
+        return {"m": m, "procs": procs, "error": f"timeout>{timeout_s}s",
+                "partial_output": partial}
+    finally:
+        (workdir / "mnist_train.mat").unlink(missing_ok=True)
+        shutil.rmtree(chdir, ignore_errors=True)
+
+    # output formats differ between the two programs: "Matches: %d" +
+    # "KNN time: %f" (blocking:272-273) vs "Matches%d" + "Time :%f"
+    # (non_blocking:290-292)
+    matches = [re.search(r"Matches:? ?(-?\d+)", o) for _, o, _ in outs]
+    ktime = None
+    for _, o, _ in outs:
+        t = re.search(r"(?:KNN time|Time) ?: ?([0-9.]+)", o)
+        if t:
+            ktime = float(t.group(1))
+    row = {
+        "m": m,
+        "d": 784,
+        "procs": procs,
+        "threads": threads,
+        "knn_time_s": ktime,
+        "matches_per_rank": [int(x.group(1)) if x else None for x in matches],
+        "rc": [rc for rc, _, _ in outs],
+        "wall_s": round(time.time() - t0, 3),
+    }
+    if all(x is not None for x in row["matches_per_rank"]):
+        row["matches_total"] = sum(row["matches_per_rank"])
+    if asan:
+        reports = [e for _, _, e in outs if "AddressSanitizer" in e]
+        row["asan_errors"] = len(reports)
+        if reports:  # error kind + the reference-source frame it fired in
+            lines = reports[0].splitlines()
+            kind = [ln.split("ERROR: AddressSanitizer: ")[1].split(" on ")[0]
+                    for ln in lines if "ERROR: AddressSanitizer" in ln]
+            frame = [ln.strip() for ln in lines
+                     if "mpi-knn-parallel" in ln or ".c:" in ln]
+            row["asan_first_error"] = " | ".join(
+                (kind[:1] or ["?"]) + frame[:1]
+            )[:300]
+    if ktime is None and "error" not in row:
+        row["error"] = "no KNN time printed (rank crashed before timer?)"
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, default=4096,
+                    help="corpus rows; must be divisible by --procs")
+    ap.add_argument("--procs", type=int, default=4)
+    ap.add_argument("--threads", type=int, default=1,
+                    help="OpenMP threads per rank (>1 exercises the Q2 race)")
+    ap.add_argument("--variants", default="blocking,non_blocking")
+    ap.add_argument("--asan", action="store_true",
+                    help="also run each variant under AddressSanitizer")
+    ap.add_argument("--timeout", type=int, default=1800)
+    ap.add_argument("--out", default="measurements/ref_mpi_cpu.json")
+    args = ap.parse_args()
+
+    if args.m % args.procs:
+        raise SystemExit("m must be divisible by procs (the reference "
+                         "assumes it; SURVEY Q6)")
+
+    from mpi_knn_tpu.data.synthetic import make_mnist_like
+
+    X, y = make_mnist_like(60000, 784, seed=0)
+
+    binaries = build_mpi_binaries()
+    rows = []
+    for variant in [v for v in args.variants.split(",") if v]:
+        row = run_mpi(binaries[variant], args.m, args.procs, args.threads,
+                      X, y, args.timeout)
+        row["variant"] = variant
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+
+    if args.asan:
+        asan_binaries = build_mpi_binaries(asan=True)
+        for variant in [v for v in args.variants.split(",") if v]:
+            row = run_mpi(asan_binaries[variant], args.m, args.procs,
+                          args.threads, X, y, args.timeout, asan=True)
+            row["variant"] = f"{variant}+asan"
+            rows.append(row)
+            print(json.dumps(row), file=sys.stderr)
+
+    # serial ground truth on the same corpus, for the accuracy comparison
+    from scripts.ref_baseline import build_binary, run_one
+
+    serial_row = run_one(build_binary(), args.m, args.timeout, X, y)
+
+    result = {
+        "what": "reference MPI programs, unmodified, via matshim+mpishim",
+        "host": "1 CPU core; one OS process per rank (FIFO transport)",
+        "timed_phase": "rank 0's own 'KNN time' print "
+                       "(blocking:273 / non_blocking:292)",
+        "serial_matches": serial_row.get("matches"),
+        "serial_clock_s": serial_row.get("clock_s"),
+        "rows": rows,
+    }
+    out = REPO / args.out
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(result, indent=1))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

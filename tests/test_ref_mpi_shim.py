@@ -1,0 +1,101 @@
+"""Run the reference's UNMODIFIED MPI programs and confirm SURVEY §5 Q1
+empirically: their distributed results diverge from their own serial
+program on identical data, while this framework's ring backend stays
+exactly serial-equal.
+
+The binaries are compiled against the clean-room mat.h + mpi.h shims
+(native/matshim, native/mpishim) and launched as one OS process per rank
+over FIFO channels — the reference's own compiled dataflow, including the
+first-exchange count/stride mismatch (``mpi-knn-parallel_blocking.c:
+129-138``: (n+2)-count receives fed by n-count sends from an (n+2)-stride
+buffer) and the never-initialized id/label columns forwarded around the
+ring (``:169`` copies only j<n), which the vote then indexes with.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_REPO = Path(__file__).resolve().parents[1]
+_REF = Path("/root/reference")
+
+M, PROCS = 512, 4
+
+
+@pytest.fixture(scope="module")
+def mpi_binaries():
+    if not (_REF / "mpi-knn-parallel_blocking.c").exists():
+        pytest.skip("reference sources unavailable")
+    import sys
+
+    sys.path.insert(0, str(_REPO))
+    from scripts.ref_mpi_baseline import build_mpi_binaries
+
+    try:
+        return build_mpi_binaries()
+    except Exception as e:  # missing toolchain/zlib — environmental
+        pytest.skip(f"cannot build reference MPI programs: {e}")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    from mpi_knn_tpu.data.synthetic import make_mnist_like
+
+    return make_mnist_like(60000, 784, seed=0)
+
+
+def _run(mpi_binaries, corpus, variant):
+    from scripts.ref_mpi_baseline import run_mpi
+
+    X, y = corpus
+    row = run_mpi(mpi_binaries[variant], M, PROCS, threads=1, X=X, y=y,
+                  timeout_s=300)
+    assert row.get("error") is None, row
+    assert row["rc"] == [0] * PROCS
+    assert row["knn_time_s"] and row["knn_time_s"] > 0
+    return row
+
+
+def test_reference_mpi_ring_diverges_from_serial_q1(mpi_binaries, corpus):
+    X, y = corpus
+    blocking = _run(mpi_binaries, corpus, "blocking")
+    non_blocking = _run(mpi_binaries, corpus, "non_blocking")
+
+    # both variants share the broken ring dataflow — identical wrong answers
+    assert blocking["matches_per_rank"] == non_blocking["matches_per_rank"]
+
+    # the framework's serial LOO on the same data (quirk vote replicates the
+    # reference serial program, which test_ref_shim pins to the binary)
+    from mpi_knn_tpu import KNNClassifier
+
+    clf = KNNClassifier(k=30, num_classes=10, backend="serial",
+                        tie_break="quirk-serial")
+    serial_matches = clf.fit(
+        X[:M].astype(np.float32), y[:M]
+    ).loo_report().matches
+
+    # Q1, empirically: the reference's own distributed run loses matches
+    # its own serial run finds
+    assert blocking["matches_total"] < serial_matches, (
+        blocking["matches_total"], serial_matches)
+
+
+def test_framework_ring_stays_serial_equal_where_reference_diverges(corpus):
+    """The contrast claim: on the exact workload where the reference's ring
+    demonstrably diverges (above), this framework's ring backend returns
+    bit-identical neighbour sets to its serial backend."""
+    from mpi_knn_tpu import KNNConfig, all_knn
+
+    X, _ = corpus
+    Xf = X[:M].astype(np.float32)
+    serial = all_knn(Xf, config=KNNConfig(k=30, backend="serial"))
+    ring = all_knn(Xf, config=KNNConfig(k=30, backend="ring"))
+    sd, si = np.asarray(serial.dists), np.asarray(serial.ids)
+    rd, ri = np.asarray(ring.dists), np.asarray(ring.ids)
+    # the distance multiset is bit-identical; ids may differ only where the
+    # distance is an exact tie (integer-valued corpus, k=30 boundary — the
+    # 8-way ring's merge order legitimately picks a different tied member)
+    np.testing.assert_array_equal(sd, rd)  # ⇒ every id mismatch is a tie
+    diff = si != ri
+    assert diff.mean() < 0.001, f"{diff.sum()} id mismatches"

@@ -35,8 +35,8 @@ def ref_binary():
         pytest.skip(f"cannot build reference against shim: {e}")
 
 
-def test_reference_binary_agrees_with_framework(ref_binary, tmp_path):
-    from scripts.ref_baseline import make_workload, run_one
+def test_reference_binary_agrees_with_framework(ref_binary):
+    from scripts.ref_baseline import run_one
     from mpi_knn_tpu import KNNClassifier
     from mpi_knn_tpu.data.synthetic import make_mnist_like
 
