@@ -187,6 +187,11 @@ def main() -> int:
     ap.add_argument("--asan", action="store_true",
                     help="also run each variant under AddressSanitizer")
     ap.add_argument("--timeout", type=int, default=1800)
+    ap.add_argument("--serial-clock-s", type=float, default=None,
+                    help="reuse a previously measured serial clock instead "
+                         "of re-running it (the m=60000 serial run takes "
+                         "2.7 h; its result is in ref_serial_cpu_60k.json)")
+    ap.add_argument("--serial-matches", type=int, default=None)
     ap.add_argument("--out", default="measurements/ref_mpi_cpu.json")
     args = ap.parse_args()
 
@@ -198,14 +203,23 @@ def main() -> int:
 
     X, y = make_mnist_like(60000, 784, seed=0)
 
-    binaries = build_mpi_binaries()
+    out = REPO / args.out
+    out.parent.mkdir(exist_ok=True)
     rows = []
+
+    def save_partial():
+        # rows are written the moment they land: a killed/timed-out later
+        # variant must not take an earlier variant's measurement with it
+        out.write_text(json.dumps({"partial": True, "rows": rows}, indent=1))
+
+    binaries = build_mpi_binaries()
     for variant in [v for v in args.variants.split(",") if v]:
         row = run_mpi(binaries[variant], args.m, args.procs, args.threads,
                       X, y, args.timeout)
         row["variant"] = variant
         rows.append(row)
         print(json.dumps(row), file=sys.stderr)
+        save_partial()
 
     if args.asan:
         asan_binaries = build_mpi_binaries(asan=True)
@@ -215,11 +229,17 @@ def main() -> int:
             row["variant"] = f"{variant}+asan"
             rows.append(row)
             print(json.dumps(row), file=sys.stderr)
+            save_partial()
 
     # serial ground truth on the same corpus, for the accuracy comparison
-    from scripts.ref_baseline import build_binary, run_one
+    if args.serial_clock_s is not None:
+        serial_row = {"clock_s": args.serial_clock_s,
+                      "matches": args.serial_matches,
+                      "note": "reused prior measurement (--serial-clock-s)"}
+    else:
+        from scripts.ref_baseline import build_binary, run_one
 
-    serial_row = run_one(build_binary(), args.m, args.timeout, X, y)
+        serial_row = run_one(build_binary(), args.m, args.timeout, X, y)
 
     result = {
         "what": "reference MPI programs, unmodified, via matshim+mpishim",
@@ -230,8 +250,6 @@ def main() -> int:
         "serial_clock_s": serial_row.get("clock_s"),
         "rows": rows,
     }
-    out = REPO / args.out
-    out.parent.mkdir(exist_ok=True)
     out.write_text(json.dumps(result, indent=1))
     print(json.dumps(result))
     return 0
