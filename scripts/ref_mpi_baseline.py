@@ -198,13 +198,17 @@ def main() -> int:
     if args.m % args.procs:
         raise SystemExit("m must be divisible by procs (the reference "
                          "assumes it; SURVEY Q6)")
+    if (args.serial_clock_s is None) != (args.serial_matches is None):
+        raise SystemExit("--serial-clock-s and --serial-matches must be "
+                         "given together (a reused clock without its match "
+                         "count breaks the accuracy comparison)")
 
     from mpi_knn_tpu.data.synthetic import make_mnist_like
 
     X, y = make_mnist_like(60000, 784, seed=0)
 
     out = REPO / args.out
-    out.parent.mkdir(exist_ok=True)
+    out.parent.mkdir(parents=True, exist_ok=True)
     rows = []
 
     def save_partial():
@@ -248,6 +252,7 @@ def main() -> int:
                        "(blocking:273 / non_blocking:292)",
         "serial_matches": serial_row.get("matches"),
         "serial_clock_s": serial_row.get("clock_s"),
+        "serial_note": serial_row.get("note"),  # provenance: reused vs fresh
         "rows": rows,
     }
     out.write_text(json.dumps(result, indent=1))
