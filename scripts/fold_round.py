@@ -1,9 +1,9 @@
-"""Render measurements/r3.jsonl (+ mfu.json / trace_ops jsons when present)
-as BASELINE.md-ready markdown tables on stdout.
+"""Render measurements/r{N}.jsonl (+ mfu rows / trace_ops jsons when
+present) as BASELINE.md-ready markdown tables on stdout.
 
 Keeps the fold from measurement to document mechanical: run the suite
-(scripts/r3_measure.sh), then `python scripts/fold_r3.py >> notes.md` and
-edit the narrative around the tables.
+(scripts/r4_measure.sh), then `python scripts/fold_round.py r4 >> notes.md`
+and edit the narrative around the tables.
 """
 
 from __future__ import annotations
@@ -31,9 +31,10 @@ def rows(path):
 
 
 def main() -> int:
-    r3 = rows(MDIR / "r3.jsonl")
+    rnd = sys.argv[1] if len(sys.argv) > 1 else "r4"
+    r3 = rows(MDIR / f"{rnd}.jsonl")
     if not r3:
-        print(f"no rows in {MDIR}/r3.jsonl", file=sys.stderr)
+        print(f"no rows in {MDIR}/{rnd}.jsonl", file=sys.stderr)
         return 1
 
     timed = [r for r in r3 if r.get("unit") == "s" and "metric" in r]
@@ -48,7 +49,7 @@ def main() -> int:
     other = [r for r in r3 if r not in timed and r not in status]
 
     if bench:
-        print("### Timed measurements (r3.jsonl)\n")
+        print(f"### Timed measurements ({rnd}.jsonl)\n")
         print("| step | metric | value | vs_baseline | extra |")
         print("|---|---|---|---|---|")
         for r in bench:
@@ -97,9 +98,13 @@ def main() -> int:
             if "variant" in r:
                 last[r["variant"]] = r
         if last:
+            # workload/peak context comes from the rows themselves (each row
+            # carries m/d/k/useful_tflop/peak since r4 — ADVICE r3); the
+            # constants are only a fallback for pre-r4 row files
+            any_row = next(iter(last.values()))
             m = {"workload": "per-variant suite steps (last row per variant)",
-                 "useful_tflop": 5.645,  # 2·60000²·784 / 1e12, the suite's
-                 "peak_bf16_tflops": 197,  # fixed MNIST-scale workload
+                 "useful_tflop": any_row.get("useful_tflop", 5.645),
+                 "peak_bf16_tflops": any_row.get("peak_bf16_tflops", 197),
                  "results": list(last.values())}
     mfu = MDIR / "mfu.json"
     if m is None and mfu.exists():
@@ -125,7 +130,7 @@ def main() -> int:
             )
         print()
 
-    for name in ("trace_ops_r3.json", "trace_ops_ring_ab.json"):
+    for name in (f"trace_ops_{rnd}.json", "trace_ops_ring_ab.json"):
         p = MDIR / name
         if not p.exists():
             continue

@@ -141,8 +141,20 @@ def main(argv=None) -> int:
     results = []
 
     def emit(row, final=True):
-        row = {**row, "ts": round(time.time(), 1)}  # rows outlive re-runs;
-        if final:                                   # the stamp dates them
+        row = {
+            **row,
+            # each row carries its workload/peak context so downstream folds
+            # never have to assume the defaults (ADVICE r3: a run with
+            # non-default --m or --peak-tflops must not fold under a wrong
+            # header)
+            "m": args.m,
+            "d": args.d,
+            "k": args.k,
+            "useful_tflop": round(useful_flop / 1e12, 3),
+            "peak_bf16_tflops": peak / 1e12,
+            "ts": round(time.time(), 1),  # rows outlive re-runs;
+        }
+        if final:                         # the stamp dates them
             results.append(row)
         print(json.dumps(row), flush=True)
         if args.append_jsonl:
