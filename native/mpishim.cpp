@@ -214,8 +214,12 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int,
   size_t got = do_recv(buf, static_cast<size_t>(count) * dt, source);
   if (status) {
     status->MPI_SOURCE = source;
-    status->MPI_TAG = 0;
-    status->MPI_ERROR = static_cast<int>(got);  // byte count, for debugging
+    // deliver the byte count through MPI_TAG (a shim-only debugging
+    // channel — the reference passes tag 0 everywhere and never reads it
+    // back); MPI_ERROR must stay MPI_SUCCESS or a conforming caller would
+    // treat every successful receive as an error (ADVICE r3)
+    status->MPI_TAG = static_cast<int>(got);
+    status->MPI_ERROR = 0;
   }
   return MPI_SUCCESS;
 }

@@ -87,10 +87,22 @@ def main() -> int:
 
     # serial oracle computed fresh in-process (single-device path)
     want = all_knn(X, config=cfg.replace(backend="serial"))
-    np.testing.assert_array_equal(fetch_global(want.ids), ids)
-    np.testing.assert_allclose(
-        fetch_global(want.dists), dists, rtol=1e-5
-    )
+    want_ids = fetch_global(want.ids)
+    want_dists = fetch_global(want.dists)
+    np.testing.assert_array_equal(want_ids, ids)
+    np.testing.assert_allclose(want_dists, dists, rtol=1e-5)
+
+    # VERDICT r3 #8: the NON-resumable ring backend's shard_mapped compute,
+    # jitted across the 2-process pod, both schedules — until r4 only the
+    # resumable driver had ever crossed a process boundary; the plain
+    # backend's cross-process jit (device_put to a global NamedSharding +
+    # ppermute over devices this process cannot address) was untested.
+    for be in ("ring", "ring-overlap"):
+        res = all_knn(X, config=cfg.replace(backend=be), mesh=mesh)
+        np.testing.assert_array_equal(fetch_global(res.ids), want_ids, err_msg=be)
+        np.testing.assert_allclose(
+            fetch_global(res.dists), want_dists, rtol=1e-5, err_msg=be
+        )
 
     print(f"proc {jax.process_index()} multihost ring resume OK", flush=True)
     return 0
