@@ -214,12 +214,13 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int,
   size_t got = do_recv(buf, static_cast<size_t>(count) * dt, source);
   if (status) {
     status->MPI_SOURCE = source;
-    // deliver the byte count through MPI_TAG (a shim-only debugging
-    // channel — the reference passes tag 0 everywhere and never reads it
-    // back); MPI_ERROR must stay MPI_SUCCESS or a conforming caller would
-    // treat every successful receive as an error (ADVICE r3)
-    status->MPI_TAG = static_cast<int>(got);
+    // conforming values: the matched send's tag (every reference send uses
+    // tag 0) and MPI_SUCCESS — a caller following MPI semantics must not
+    // see the old byte-count-in-MPI_ERROR debug hack (ADVICE r3). The byte
+    // count survives in the shim-only TKNN_BYTES field instead.
+    status->MPI_TAG = 0;
     status->MPI_ERROR = 0;
+    status->TKNN_BYTES = static_cast<int>(got);
   }
   return MPI_SUCCESS;
 }

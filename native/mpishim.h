@@ -26,6 +26,8 @@ typedef struct {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
+  int TKNN_BYTES;  /* shim-only: bytes delivered by the matching receive
+                      (debug channel; real MPI has opaque extra fields) */
 } MPI_Status;
 
 typedef struct TknnMpiReq *MPI_Request;  /* opaque; filled by Isend/Irecv */

@@ -171,7 +171,8 @@ EOF
 }
 
 STEPS="${*:-confirm \
-  svd1 svd10 svd100 ring_block ring_overlap ring_bf16x mfu_dist \
+  svd1 svd10 svd100 ring_block ring_overlap ring_block_u ring_bf16x \
+  mfu_dist \
   mfu_twolevel mfu_stream traces ring_ab \
   sift100_l2_exact sift100_cos_exact sift100_l2_approx sift100_cos_approx \
   tputests ring256k_exact ring256k_approx \
@@ -231,8 +232,18 @@ ring_block)  # VERDICT #7: ring-vs-serial overhead at P=1, blocking
 ring_overlap)
   BENCH_BACKEND=ring-overlap bench_env run_step ring-overlap-p1 cheap 420 \
     python bench.py ;;
-ring_bf16x)  # transfer-dtype cast cost (halved ICI bytes on real meshes)
-  BENCH_BACKEND=ring BENCH_RING_XFER=bfloat16 bench_env \
+ring_block_u)  # uncentered ring-block CONTROL row: pairs with ring_bf16x
+  # below so the cast-cost A/B differs in the transfer dtype ONLY (both
+  # uncentered; centering runs inside the timed region, so comparing
+  # bf16-xfer-uncentered against the centered ring_block would fold the
+  # centering pass into the "cast cost")
+  BENCH_BACKEND=ring BENCH_CENTER=0 bench_env \
+    run_step ring-block-p1-uncentered cheap 420 python bench.py ;;
+ring_bf16x)  # transfer-dtype cast cost (halved ICI bytes on real meshes).
+  # Uncentered: the cast rounds the LOCAL block too, so on centered data
+  # this mode can never pass the 0.999 recall gate (CPU-verified); raw
+  # integer pixels are bf16-exact, making the timing row meaningful
+  BENCH_BACKEND=ring BENCH_RING_XFER=bfloat16 BENCH_CENTER=0 bench_env \
     run_step ring-bf16xfer-p1 cheap 420 python bench.py ;;
 mfu_dist)  # distance-only phase, own process — later variants can't lose it
   run_step mfu_dist cheap 600 python scripts/profile_mfu.py \
