@@ -171,7 +171,8 @@ EOF
 }
 
 STEPS="${*:-confirm \
-  svd1 svd10 svd100 ring_block ring_overlap ring_block_u ring_bf16x \
+  ct4096 ct2048 svd1 svd10 svd100 \
+  ring_block ring_overlap ring_block_u ring_bf16x \
   mfu_dist \
   mfu_twolevel mfu_stream traces ring_ab \
   sift100_l2_exact sift100_cos_exact sift100_l2_approx sift100_cos_approx \
@@ -180,9 +181,13 @@ STEPS="${*:-confirm \
   sift1m_l2_exact sift1m_cos_exact sift1m_l2_approx sift1m_cos_approx \
   pallas_tiles pallas_sweep traces2}"
 
-bench_env() {  # shared wedge-safe bench defaults
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high \
-  BENCH_CT=8192 BENCH_WATCHDOG_S=240 "$@"
+bench_env() {  # shared wedge-safe bench defaults; every knob overridable
+  # by env-prefixing the caller (e.g. BENCH_CT=4096 bench_env run_step ...)
+  BENCH_SCHEDULE="${BENCH_SCHEDULE:-twolevel}" \
+  BENCH_TOPK="${BENCH_TOPK:-exact}" \
+  BENCH_PRECISION="${BENCH_PRECISION:-high}" \
+  BENCH_CT="${BENCH_CT:-8192}" \
+  BENCH_WATCHDOG_S="${BENCH_WATCHDOG_S:-240}" "$@"
 }
 
 svd_step() {  # svd_step k
@@ -223,6 +228,14 @@ aggregate_traces() {  # aggregate_traces stepname — host-side; silently a
 for s in $STEPS; do KEY=$s; case $s in
 confirm)  # the r3-proven config; this row is the round's insurance policy
   bench_env run_step confirm safe 300 python bench.py ;;
+ct4096)  # NARROWER corpus tiles: every prior sweep went wider
+  # (12288/16384); if per-tile lax.top_k cost grows superlinearly in
+  # width, narrower tiles + one more merge level could beat 8192. Same
+  # kernel risk profile as the proven confirm config (strictly narrower
+  # top_k), hence cheap tier
+  BENCH_CT=4096 bench_env run_step bench-ct4096 cheap 300 python bench.py ;;
+ct2048)
+  BENCH_CT=2048 bench_env run_step bench-ct2048 cheap 300 python bench.py ;;
 svd1) svd_step 1 ;;
 svd10) svd_step 10 ;;
 svd100) svd_step 100 ;;
@@ -312,28 +325,19 @@ print(json.dumps({"step": f"ring256k-{tk}", "phase_seconds": r["phase_seconds"],
 EOF
   ;;
 bf16topk)  # VERDICT #6 candidate A: half-width-key preselect
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=bf16 BENCH_PRECISION=high \
-  BENCH_CT=8192 BENCH_WATCHDOG_S=240 \
-    run_step bench-bf16-topk risky 300 python bench.py ;;
+  BENCH_TOPK=bf16 bench_env run_step bench-bf16-topk risky 300 \
+    python bench.py ;;
 bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_DTYPE=bfloat16 \
-  BENCH_CENTER=0 BENCH_CT=8192 BENCH_WATCHDOG_S=240 \
+  BENCH_DTYPE=bfloat16 BENCH_CENTER=0 bench_env \
     run_step bench-bf16-uncentered risky 300 python bench.py ;;
 ct12288)  # wider lax.top_k concats: the r1 wedge mode, scaled down
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high \
-  BENCH_CT=12288 BENCH_WATCHDOG_S=240 \
-    run_step bench-ct12288 risky 300 python bench.py ;;
+  BENCH_CT=12288 bench_env run_step bench-ct12288 risky 300 python bench.py ;;
 ct16384)
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high \
-  BENCH_CT=16384 BENCH_WATCHDOG_S=240 \
-    run_step bench-ct16384 risky 300 python bench.py ;;
+  BENCH_CT=16384 bench_env run_step bench-ct16384 risky 300 python bench.py ;;
 qt8192)
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=exact BENCH_PRECISION=high \
-  BENCH_QT=8192 BENCH_CT=8192 BENCH_WATCHDOG_S=240 \
-    run_step bench-qt8192 risky 300 python bench.py ;;
+  BENCH_QT=8192 bench_env run_step bench-qt8192 risky 300 python bench.py ;;
 approx95)  # approx_min_k wedged this chip in r3 — risky by evidence
-  BENCH_SCHEDULE=twolevel BENCH_TOPK=approx BENCH_RT=0.95 \
-  BENCH_PRECISION=high BENCH_CT=8192 BENCH_WATCHDOG_S=240 \
+  BENCH_TOPK=approx BENCH_RT=0.95 bench_env \
     run_step bench-approx-rt95 risky 300 python bench.py ;;
 sift1m_l2_exact)    sift_step sift1m-l2-exact      risky 2400 1000000 l2 exact 1800 ;;
 sift1m_cos_exact)   sift_step sift1m-cosine-exact  risky 2400 1000000 cosine exact 1800 ;;
