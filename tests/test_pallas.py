@@ -108,3 +108,34 @@ def test_sweep_k_exceeding_carry_falls_back(rng):
     np.testing.assert_allclose(
         np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3
     )
+
+
+def test_sweep_nan_row_yields_invalid_ids():
+    """A row whose distances are all NaN (inf inputs make q_sq - 2xy + c_sq
+    indeterminate) must emit INVALID_ID, not garbage: the r4 affine-id fast
+    path computes first_col via a min over an all-False mask, which
+    saturates at int32 max — without the isfinite guard that wraps into a
+    negative id instead of INVALID_ID."""
+    from mpi_knn_tpu.ops.pallas_knn import _k_smallest_sweep
+    from mpi_knn_tpu.types import INVALID_ID
+    import jax.numpy as jnp
+
+    d = jnp.stack([
+        jnp.full((8,), jnp.nan, dtype=jnp.float32),   # poisoned row
+        jnp.arange(8, dtype=jnp.float32),             # healthy row
+    ])
+    # affine path (tile extraction)
+    dists, ids = _k_smallest_sweep(d, None, 3, col_offset=16)
+    assert (np.asarray(ids)[0] == INVALID_ID).all(), np.asarray(ids)[0]
+    np.testing.assert_array_equal(np.asarray(ids)[1], [16, 17, 18])
+    # the poisoned row's distances stay NaN (the extraction never invents
+    # values); the healthy row's are the true ascending mins
+    assert np.isnan(np.asarray(dists)[0]).all()
+    np.testing.assert_array_equal(np.asarray(dists)[1], [0.0, 1.0, 2.0])
+    # explicit-ids path (carry merge) must agree
+    cand = jnp.arange(16, 24, dtype=jnp.int32)[None, :].repeat(2, axis=0)
+    dists2, ids2 = _k_smallest_sweep(d, cand, 3)
+    np.testing.assert_array_equal(np.asarray(ids), np.asarray(ids2))
+    np.testing.assert_array_equal(
+        np.asarray(dists)[1], np.asarray(dists2)[1]
+    )
