@@ -148,11 +148,15 @@ def main() -> int:
                 if not plane.lower().startswith(("/device", "/tpu")) and \
                         "TPU" not in plane:
                     continue  # host planes are noise for the device story
+                span = (f"; async span {rep['collective_span_ms']} ms, "
+                        f"span-overlap "
+                        f"{rep['collective_span_overlapped_with_matmul_ms']}"
+                        f" ms") if rep.get("collective_span_ms") else ""
                 print(f"- **{f}** `{plane}`: busy by category "
                       f"{rep['busy_ms_by_category']}; collective total "
                       f"{rep['collective_total_ms']} ms, overlapped with "
                       f"matmul {rep['collective_overlapped_with_matmul_ms']}"
-                      f" ms")
+                      f" ms{span}")
         print()
     return 0
 

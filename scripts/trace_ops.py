@@ -209,6 +209,34 @@ def analyze(events: list, top: int = 15):
         coll_under_mm = overlap_ps(
             cat_iv.get("collective", []), cat_iv.get("matmul", [])
         )
+        # Async collectives on TPU appear as '<op>-start.N' / '<op>-done.N'
+        # event pairs; the in-flight DMA time is the GAP between them and is
+        # attributed to neither event, so the busy-interval overlap above
+        # under-reports hidden transfer. Pair starts with dones by name stem
+        # and occurrence order and measure the full span instead.
+        starts, dones = defaultdict(list), defaultdict(list)
+        for ev in evs:
+            if not ev["dur_ps"] or categorize(ev["name"]) != "collective":
+                continue
+            low = ev["name"].lower()
+            iv = (ev["start_ps"], ev["start_ps"] + ev["dur_ps"])
+            if "-start" in low:
+                starts[low.replace("-start", "", 1)].append(iv)
+            elif "-done" in low:
+                dones[low.replace("-done", "", 1)].append(iv)
+        spans = []
+        for stem, ss in starts.items():
+            ds = dones.get(stem, [])
+            if len(ds) != len(ss):
+                # a trace cut mid-flight (or a zero-duration done dropped by
+                # the busy filter) breaks order-based pairing — a misaligned
+                # zip would bridge unrelated rounds and count ordinary
+                # compute as hidden transfer. Under-report instead.
+                continue
+            for (s0, _), (_, d1) in zip(sorted(ss), sorted(ds)):
+                if d1 > s0:
+                    spans.append((s0, d1))
+        span_under_mm = overlap_ps(spans, cat_iv.get("matmul", []))
         report[plane] = {
             "busy_ms_by_category": {
                 k: round(v / 1e9, 3) for k, v in sorted(by_cat.items())
@@ -218,6 +246,14 @@ def analyze(events: list, top: int = 15):
             ),
             "collective_overlapped_with_matmul_ms": round(
                 coll_under_mm / 1e9, 3
+            ),
+            # span metrics are 0 when the trace has no async start/done
+            # pairs (sync collectives, or CPU traces)
+            "collective_span_ms": round(
+                sum(e - s for s, e in spans) / 1e9, 3
+            ),
+            "collective_span_overlapped_with_matmul_ms": round(
+                span_under_mm / 1e9, 3
             ),
             "top_ops_ms": {
                 k: round(v / 1e9, 3)

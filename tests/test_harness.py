@@ -371,7 +371,7 @@ def test_cli_profile_writes_trace(tmp_path):
     # the wire-format trace parser must read what jax.profiler wrote:
     # at least one plane with busy categories, and a clean per-file error
     # (not an abort) on a truncated trace
-    sys.path.insert(0, "/root/repo/scripts")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
     try:
         import trace_ops
     finally:
@@ -386,3 +386,41 @@ def test_cli_profile_writes_trace(tmp_path):
     bad.write_bytes(b"\xff\xff\xff")
     with pytest.raises((ValueError, IndexError)):
         trace_ops.parse_xplane(str(bad))
+
+
+def test_trace_ops_async_collective_span_overlap():
+    """TPU async collectives trace as '-start'/'-done' pairs whose in-flight
+    DMA time belongs to neither event; the span metric (start of start-op to
+    end of done-op, paired by name stem and occurrence order) must credit a
+    matmul that runs inside that gap as hidden transfer, while the plain
+    busy-interval overlap reads ~0."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        import trace_ops
+    finally:
+        sys.path.pop(0)
+
+    ms = 1_000_000_000  # ps per ms
+    events = [
+        # round 1: transfer in flight 0..10ms (start op busy 0-1, done 9-10)
+        dict(plane="/device:TPU:0", line="XLA Ops",
+             name="collective-permute-start.1", start_ps=0, dur_ps=1 * ms),
+        dict(plane="/device:TPU:0", line="XLA Ops",
+             name="collective-permute-done.1", start_ps=9 * ms, dur_ps=1 * ms),
+        # the distance matmul runs 2..8ms — fully inside the DMA gap
+        dict(plane="/device:TPU:0", line="XLA Ops",
+             name="fusion.42", start_ps=2 * ms, dur_ps=6 * ms),
+        # round 2 of the same instruction: 20..24ms span, matmul elsewhere
+        dict(plane="/device:TPU:0", line="XLA Ops",
+             name="collective-permute-start.1", start_ps=20 * ms, dur_ps=1 * ms),
+        dict(plane="/device:TPU:0", line="XLA Ops",
+             name="collective-permute-done.1", start_ps=23 * ms, dur_ps=1 * ms),
+    ]
+    rep = trace_ops.analyze(events)["/device:TPU:0"]
+    # busy-interval overlap: start/done events never intersect the matmul
+    assert rep["collective_overlapped_with_matmul_ms"] == 0.0, rep
+    # spans: 0..10 and 20..24 -> 14 ms total, 6 ms under the matmul
+    assert rep["collective_span_ms"] == 14.0, rep
+    assert rep["collective_span_overlapped_with_matmul_ms"] == 6.0, rep
+    # sanity: categories aggregated as expected
+    assert rep["busy_ms_by_category"]["matmul"] == 6.0, rep
