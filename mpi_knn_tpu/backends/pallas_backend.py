@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
-from mpi_knn_tpu.ops.pallas_knn import fused_knn_sweep, fused_knn_tiles
+from mpi_knn_tpu.ops.distance import _l2_normalize, sq_norms
+from mpi_knn_tpu.ops.pallas_knn import _ZERO_RTOL, fused_knn_sweep, fused_knn_tiles
 from mpi_knn_tpu.ops.topk import smallest_k
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
@@ -91,8 +92,6 @@ def all_knn_pallas(
     query_ids: np.ndarray,
     cfg: KNNConfig,
 ):
-    if cfg.metric != "l2":
-        raise ValueError("pallas backend currently supports metric='l2' only")
     if cfg.dtype != "float32":
         raise ValueError(
             f"pallas backend computes in float32; dtype={cfg.dtype!r} is not "
@@ -100,6 +99,42 @@ def all_knn_pallas(
         )
     m, dim = corpus.shape
     nq = queries.shape[0]
+
+    # Cosine rides the L2 kernels: on unit vectors the kernel's squared-L2
+    # output is exactly 2·(1 − cos sim) — monotonic with cosine distance
+    # (same top-k), converted back to the serial backend's cosine-distance
+    # space (ops.distance.pairwise_cosine) by halving on the way out. The
+    # zero-exclusion epsilon maps the same way: serial's threshold in
+    # cosine space (absolute cfg.zero_eps, else _ZERO_RTOL·scale with
+    # scale = 2.0 — backends/serial.py) doubles into kernel d² space.
+    cosine = cfg.metric == "cosine"
+    if cosine:
+        # The d² = 2·d_cos identity requires UNIT rows; a zero row
+        # normalizes to the zero vector (serial: distance 1.0 to
+        # everything) and would come out as 0.5 here. Degenerate input →
+        # route the whole call to serial for exact semantics (the check is
+        # one reduced scalar off-device, not a data fetch).
+        all_pairs_same = queries is corpus
+        corpus = jnp.asarray(corpus, dtype=jnp.float32)
+        queries = corpus if all_pairs_same else jnp.asarray(
+            queries, dtype=jnp.float32
+        )
+        any_zero = (sq_norms(corpus) == 0).any()
+        if not all_pairs_same:
+            any_zero = any_zero | (sq_norms(queries) == 0).any()
+        if bool(jax.device_get(any_zero)):
+            from mpi_knn_tpu.backends.serial import all_knn_serial
+
+            return all_knn_serial(corpus, queries, query_ids, cfg)
+        # normalize on device (jnp), once when queries IS corpus (the
+        # all-pairs reference workload): a host round-trip at MNIST scale
+        # is minutes over tunneled transports
+        corpus = _l2_normalize(corpus)
+        queries = corpus if all_pairs_same else _l2_normalize(queries)
+        zero_eps = 2.0 * (
+            cfg.zero_eps if cfg.zero_eps > 0 else _ZERO_RTOL * 2.0
+        )
+        cfg = cfg.replace(zero_eps=zero_eps)
     # the kernel derives candidate/query ids from grid position, which covers
     # the two real cases: all-pairs (query i is corpus row i) and query mode
     # (queries carry no corpus identity)
@@ -134,4 +169,8 @@ def all_knn_pallas(
     best_d, best_i = _pallas_all_knn(
         queries_p, corpus_p, cfg, q_tile, c_tile, m, all_pairs, variant
     )
+    if cosine:
+        # back to cosine-distance space (d² on unit vectors = 2·d_cos);
+        # inf sentinels for invalid slots survive the halving
+        best_d = best_d * 0.5
     return best_d[:nq], best_i[:nq]

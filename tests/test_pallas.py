@@ -63,10 +63,34 @@ def test_pallas_duplicate_exclusion(rng, variant):
     assert 60 not in ids[5] and 5 not in ids[60]
 
 
-def test_pallas_rejects_cosine(rng):
-    X = _blobs(rng, m=64, d=8)
-    with pytest.raises(ValueError):
-        all_knn(X, k=3, backend="pallas", metric="cosine")
+def test_pallas_cosine_matches_serial(rng, variant):
+    """Cosine rides the L2 kernels on normalized vectors (d² = 2·d_cos);
+    returned distances must be in the serial backend's cosine-distance
+    space and the neighbor sets identical."""
+    X = _blobs(rng, m=150, d=24)
+    pal = all_knn(X, k=7, backend="pallas", pallas_variant=variant,
+                  metric="cosine", query_tile=32, corpus_tile=64)
+    ser = all_knn(X, k=7, backend="serial", metric="cosine",
+                  query_tile=32, corpus_tile=64)
+    np.testing.assert_allclose(
+        np.asarray(pal.dists), np.asarray(ser.dists), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(pal.ids), np.asarray(ser.ids))
+
+
+def test_pallas_cosine_duplicate_exclusion(rng, variant):
+    """A colinear (scaled) pair is a cosine-duplicate: the zero-exclusion
+    epsilon mapping (2× into kernel d² space) must drop it exactly like
+    the serial backend does."""
+    X = _blobs(rng, m=64, d=16)
+    X[5] = X[60] * 3.0  # same direction, different magnitude
+    pal = all_knn(X, k=4, backend="pallas", pallas_variant=variant,
+                  metric="cosine", query_tile=32, corpus_tile=64)
+    ser = all_knn(X, k=4, backend="serial", metric="cosine",
+                  query_tile=32, corpus_tile=64)
+    ids = np.asarray(pal.ids)
+    assert 60 not in ids[5] and 5 not in ids[60]
+    np.testing.assert_array_equal(ids, np.asarray(ser.ids))
 
 
 def test_pallas_rejects_unknown_variant(rng):
@@ -139,3 +163,19 @@ def test_sweep_nan_row_yields_invalid_ids():
     np.testing.assert_array_equal(
         np.asarray(dists)[1], np.asarray(dists2)[1]
     )
+
+
+def test_pallas_cosine_zero_row_falls_back_to_serial(rng, variant):
+    """Zero vectors break the d² = 2·d_cos identity (they normalize to the
+    zero vector: serial says distance 1.0 to everything, the kernel would
+    say 0.5) — the backend must detect them and route to serial."""
+    X = _blobs(rng, m=96, d=16)
+    X[17] = 0.0
+    pal = all_knn(X, k=5, backend="pallas", pallas_variant=variant,
+                  metric="cosine", query_tile=32, corpus_tile=64)
+    ser = all_knn(X, k=5, backend="serial", metric="cosine",
+                  query_tile=32, corpus_tile=64)
+    np.testing.assert_allclose(
+        np.asarray(pal.dists), np.asarray(ser.dists), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(pal.ids), np.asarray(ser.ids))
