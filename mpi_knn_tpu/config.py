@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
-TOPK_METHODS = ("exact", "approx", "block", "bf16")
+TOPK_METHODS = ("exact", "approx", "approx-rerank", "block", "bf16")
 MERGE_SCHEDULES = ("stream", "twolevel")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
@@ -45,11 +45,15 @@ class KNNConfig:
       zero_eps: threshold for ``exclude_zero`` in squared-distance space.
       topk_method: ``exact`` (``lax.top_k``), ``approx``
         (``lax.approx_min_k``, the TPU-optimized partial reduction from the
-        TPU-KNN paper — see PAPERS.md), ``block`` (exact two-level
-        reduction via narrow per-block sorts), or ``bf16`` (near-exact
-        half-width-key preselect + exact f32 finish) — ops/topk.py
-        ``smallest_k``.
-      recall_target: recall target for ``approx`` top-k.
+        TPU-KNN paper — see PAPERS.md), ``approx-rerank`` (the paper's
+        peak-FLOPs recipe: unaggregated approx preselect of 4k candidates
+        at ``recall_target`` — which may sit far below the final recall
+        you need, overfetch covers the gap — then an exact f32 rerank),
+        ``block`` (exact two-level reduction via narrow per-block sorts),
+        or ``bf16`` (near-exact half-width-key preselect + exact f32
+        finish) — ops/topk.py ``smallest_k``.
+      recall_target: recall target for ``approx`` / the preselect of
+        ``approx-rerank``.
       topk_block: first-level sort width for ``block``.
       merge_schedule: ``stream`` (carry merged per corpus tile) or
         ``twolevel`` (local top-k per tile, one cascade merge at the end) —

@@ -51,6 +51,21 @@ def _fold_topk(dists: jax.Array, ids: jax.Array, k: int, width: int):
     return (-neg).reshape(q, nch * k), out_ids.reshape(q, nch * k)
 
 
+def _pad_lanes(dists: jax.Array, ids: jax.Array, multiple: int = 128):
+    """Lane-align the reduction input with (+inf, INVALID_ID) columns:
+    ``approx_min_k`` over a width that is not a multiple of 128 (e.g. the
+    stream schedule's carry‖tile concat, k+8192 wide) was observed to hang
+    the tunneled device transport, while 128-aligned widths run clean
+    (BASELINE.md r3). The sentinels can never enter a k-smallest result.
+    Load-bearing wedge guard — every ``approx_min_k`` call site must pad
+    through this helper."""
+    pad = (-dists.shape[-1]) % multiple
+    if pad:
+        dists = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=_INF)
+        ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=INVALID_ID)
+    return dists, ids
+
+
 def smallest_k(
     dists: jax.Array,
     ids: jax.Array,
@@ -75,8 +90,17 @@ def smallest_k(
         very-wide-sort transport wedge observed at c ≳ 60k (BASELINE.md);
         "bf16" = near-exact half-width-key preselect (4k candidates by
         bf16 sort, exact f32 finish) — no exactness guarantee, recall is
-        measured by the caller's gate.
-      recall_target: recall target for "approx".
+        measured by the caller's gate;
+        "approx-rerank" = the TPU-KNN paper's peak-FLOPs recipe
+        (PAPERS.md, arxiv 2206.14286): approx_min_k PRESELECTS 4k
+        candidates with overfetch (the per-candidate recall_target can be
+        far below the caller's gate — a true top-k member is lost only if
+        it falls out of the top-4k of the partial reduction), then an
+        exact f32 top-k reranks the survivors. Distinct from "approx",
+        which asks the partial reduction for the final k directly and
+        therefore needs recall_target ≈ 1 (measured slow, BASELINE.md r3).
+      recall_target: recall target for "approx" / the preselect of
+        "approx-rerank".
       block: column width of the first-level sort for "block".
 
     Returns:
@@ -93,6 +117,20 @@ def smallest_k(
     if method == "block" and k <= block and c > block:
         dists, ids = _fold_topk(dists, ids, k, block)
         c = dists.shape[-1]
+    if method == "approx-rerank" and c > 4 * k:
+        # overfetched approx preselect (cheap partial reduction), exact
+        # rerank below. aggregate_to_topk=False: the paper's recipe — the
+        # partial reduction's RAW per-bin winners go straight to the exact
+        # rerank; the default True would insert a redundant exact top-4k
+        # aggregation between the reduce and the rerank. Recall can only
+        # improve: the raw winner set is a superset of its own top-4k.
+        dists, ids = _pad_lanes(dists, ids)
+        dists, pos = jax.lax.approx_min_k(
+            dists, 4 * k, recall_target=recall_target,
+            aggregate_to_topk=False,
+        )
+        ids = jnp.take_along_axis(ids, pos, axis=-1)
+        c = dists.shape[-1]
     if method == "bf16" and c > 4 * k and dists.dtype == jnp.float32:
         # preselect 4k candidates by sorting HALF-WIDTH keys (bf16 compare
         # is monotone in the f32 values it rounds from), then finish with
@@ -106,15 +144,7 @@ def smallest_k(
         ids = jnp.take_along_axis(ids, pos, axis=-1)
         c = pre
     if method == "approx" and c > k:
-        # lane-align the reduction input: approx_min_k over a width that is
-        # not a multiple of 128 (e.g. the stream schedule's carry‖tile concat,
-        # k+8192 wide) was observed to hang the tunneled device transport,
-        # while 128-aligned widths run clean (BASELINE.md r3). +inf/-1
-        # padding cannot enter the result.
-        pad = (-c) % 128
-        if pad:
-            dists = jnp.pad(dists, ((0, 0), (0, pad)), constant_values=_INF)
-            ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=INVALID_ID)
+        dists, ids = _pad_lanes(dists, ids)
         vals, pos = jax.lax.approx_min_k(dists, k, recall_target=recall_target)
     else:
         neg, pos = jax.lax.top_k(-dists, k)

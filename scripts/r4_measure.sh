@@ -177,7 +177,7 @@ STEPS="${*:-confirm \
   mfu_twolevel mfu_stream traces ring_ab \
   sift100_l2_exact sift100_cos_exact sift100_l2_approx sift100_cos_approx \
   tputests ring256k_exact ring256k_approx \
-  bf16topk bf16raw ct12288 ct16384 qt8192 approx95 \
+  bf16topk bf16raw apxr90 apxr95 ct12288 ct16384 qt8192 approx95 \
   sift1m_l2_exact sift1m_cos_exact sift1m_l2_approx sift1m_cos_approx \
   pallas_tiles pallas_sweep traces2}"
 
@@ -339,6 +339,14 @@ qt8192)
 approx95)  # approx_min_k wedged this chip in r3 — risky by evidence
   BENCH_TOPK=approx BENCH_RT=0.95 bench_env \
     run_step bench-approx-rt95 risky 300 python bench.py ;;
+apxr90)  # TPU-KNN paper recipe: overfetched approx preselect (rt=0.9,
+  # cheap partial reduction) + exact f32 rerank; the bench's fixed 0.999
+  # recall GATE still judges the measured result
+  BENCH_TOPK=approx-rerank BENCH_RT=0.90 bench_env \
+    run_step bench-apxr-rt90 risky 300 python bench.py ;;
+apxr95)
+  BENCH_TOPK=approx-rerank BENCH_RT=0.95 bench_env \
+    run_step bench-apxr-rt95 risky 300 python bench.py ;;
 sift1m_l2_exact)    sift_step sift1m-l2-exact      risky 2400 1000000 l2 exact 1800 ;;
 sift1m_cos_exact)   sift_step sift1m-cosine-exact  risky 2400 1000000 cosine exact 1800 ;;
 sift1m_l2_approx)   sift_step sift1m-l2-approx     risky 2400 1000000 l2 approx 1800 ;;

@@ -192,3 +192,49 @@ def test_approx_method_runs_on_cpu(rng):
         d, np.broadcast_to(np.arange(64, dtype=np.int32), d.shape), 5
     )
     np.testing.assert_allclose(np.sort(np.asarray(got_d)), want_d, rtol=1e-6)
+
+
+def test_approx_rerank_method_recall(rng):
+    """'approx-rerank' (TPU-KNN recipe: overfetched approx preselect +
+    exact f32 rerank) makes no exactness claim, but on CPU approx_min_k is
+    an exact fallback, so the output must match exact top-k — and every
+    returned pair must be self-consistent against the input."""
+    d = rng.standard_normal((16, 640)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(640, dtype=np.int32), (16, 640))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 8, method="approx-rerank",
+        recall_target=0.9,
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 8)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+    # each returned pair is a real (id, dist) from the input row
+    for r in range(16):
+        for dist, i in zip(np.asarray(got_d)[r], np.asarray(got_i)[r]):
+            assert d[r, i] == dist
+
+
+def test_approx_rerank_small_c_falls_back_exact(rng):
+    """c <= 4k: no preselect possible, plain exact path."""
+    d = rng.standard_normal((4, 20)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(20, dtype=np.int32), (4, 20))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 6, method="approx-rerank"
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 6)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
+def test_approx_rerank_nondivisible_width_padded(rng):
+    """The 128-lane alignment pad (+inf/-1) must never surface in results
+    (the r3 transport-wedge guard applies to the preselect too)."""
+    d = rng.standard_normal((5, 333)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(333, dtype=np.int32), (5, 333))
+    got_d, got_i = smallest_k(
+        jnp.asarray(d), jnp.asarray(ids[0]), 7, method="approx-rerank"
+    )
+    want_d, want_i = _np_smallest_k(d, ids, 7)
+    np.testing.assert_allclose(np.asarray(got_d), want_d, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), want_i)
+    assert (np.asarray(got_i) >= 0).all()
