@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
-from mpi_knn_tpu.ops.distance import _l2_normalize, sq_norms
+from mpi_knn_tpu.ops.distance import _NORM_EPS, _l2_normalize, sq_norms
 from mpi_knn_tpu.ops.pallas_knn import _ZERO_RTOL, fused_knn_sweep, fused_knn_tiles
 from mpi_knn_tpu.ops.topk import smallest_k
 from mpi_knn_tpu.parallel.partition import (
@@ -119,9 +119,14 @@ def all_knn_pallas(
         queries = corpus if all_pairs_same else jnp.asarray(
             queries, dtype=jnp.float32
         )
-        any_zero = (sq_norms(corpus) == 0).any()
+        # Guard must match _l2_normalize's clamp: a row with
+        # 0 < ||x||² <= _NORM_EPS is NOT normalized to unit length (the
+        # clamp wins), so it breaks the d² = 2·d_cos identity just like an
+        # exact zero row. Route anything the normalizer would clamp to
+        # serial.
+        any_zero = (sq_norms(corpus) <= _NORM_EPS).any()
         if not all_pairs_same:
-            any_zero = any_zero | (sq_norms(queries) == 0).any()
+            any_zero = any_zero | (sq_norms(queries) <= _NORM_EPS).any()
         if bool(jax.device_get(any_zero)):
             from mpi_knn_tpu.backends.serial import all_knn_serial
 

@@ -19,9 +19,12 @@ the serial backend.
 Two variants, matching the reference's pair but with the overlap done right:
 
 - ``overlap=False`` ("ring", blocking parity): each scan step *computes, then
-  permutes*, with an ``optimization_barrier`` forcing the collective to wait
-  for the compute — the reference's blocking schedule, kept as a pedagogical
-  baseline and as the A side of the overlap A/B benchmark.
+  permutes*, with an ``optimization_barrier`` threading the compute outputs so
+  the collective truly waits for the compute — the reference's blocking
+  schedule, kept as a pedagogical baseline and as the A side of the overlap
+  A/B benchmark. Machine-checked in HLO (``tests/test_hlo_overlap.py``);
+  enforced on the 1-D ring (the reference's layout) — see the in-step note
+  for why a multi-axis mesh pins only the block.
 - ``overlap=True`` ("ring-overlap"): the permute of block b+1 is issued in the
   same scan step that computes distances against block b, with no dependency
   between them — XLA schedules the ICI DMA under the MXU matmul. This is the
@@ -153,9 +156,30 @@ def _ring_knn_local(
         else:
             # blocking parity: the collective is sequenced *after* the compute
             # via an explicit barrier, modelling the reference's
-            # compute-then-Send/Recv schedule
+            # compute-then-Send/Recv schedule. The carry MUST thread through
+            # the barrier too: a barrier over (blk, blk_ids) alone creates no
+            # data dependence from the compute to the permute, and XLA may
+            # schedule them concurrently — i.e. "blocking" would silently be
+            # the overlap schedule (caught by tests/test_hlo_overlap.py,
+            # which found exactly that bug in the pre-r5 code).
             cd, ci = compute(blk, blk_ids, cd, ci)
-            blk, blk_ids = jax.lax.optimization_barrier((blk, blk_ids))
+            if set(vary_axes or (axis,)) == {axis}:
+                blk, blk_ids, cd, ci = jax.lax.optimization_barrier(
+                    (blk, blk_ids, cd, ci)
+                )
+            else:
+                # Multi-axis mesh: the carry varies over every mesh axis
+                # and an optimization_barrier unifies its outputs' varying
+                # sets, so threading the carry would make the block
+                # dp-varying — an invalid type for the scan carry and for
+                # the resumable driver's P(ring) out_spec (this JAX has no
+                # varying->invarying pcast). The barrier then pins only the
+                # block: results stay bit-identical, but compute->permute
+                # sequencing is NOT enforced here. The blocking schedule as
+                # a reference-parity/A-B object is defined on the 1-D ring
+                # (scripts/ring_ab.py, tests/test_hlo_overlap.py), which is
+                # the layout the reference implements.
+                blk, blk_ids = jax.lax.optimization_barrier((blk, blk_ids))
             nxt = jax.lax.ppermute(blk, axis, perm)
             nxt_ids = jax.lax.ppermute(blk_ids, axis, perm)
         return (nxt, nxt_ids, cd, ci), None

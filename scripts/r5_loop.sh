@@ -1,0 +1,29 @@
+#!/bin/bash
+# Outer retry loop for the round-5 measurement suite: relaunch on
+# device-dead aborts (the wedge clears on its own schedule — probe-and-wait
+# is the only strategy), resume from the done-file, stop at the deadline.
+#
+# Usage: bash scripts/r5_loop.sh
+# Env:   DEADLINE_EPOCH        hard stop (default: now + 10h)
+#        RISKY_DEADLINE_EPOCH  last start for wedge-risky steps
+#                              (default: DEADLINE_EPOCH - 3h — a wedge needs
+#                              hours to clear before the driver's bench)
+set -u
+cd "$(dirname "$0")/.."
+export DEADLINE_EPOCH=${DEADLINE_EPOCH:-$(( $(date +%s) + 36000 ))}
+export RISKY_DEADLINE_EPOCH=${RISKY_DEADLINE_EPOCH:-$(( DEADLINE_EPOCH - 10800 ))}
+echo "r5 loop: deadline $(date -d @"$DEADLINE_EPOCH" -Is), risky until" \
+     "$(date -d @"$RISKY_DEADLINE_EPOCH" -Is)" >&2
+
+while [ "$(date +%s)" -le "$DEADLINE_EPOCH" ]; do
+  bash scripts/r5_measure.sh
+  rc=$?
+  case $rc in
+    3) echo "r5 loop: all steps done" >&2; exit 0 ;;
+    0) echo "r5 loop: pass complete, steps pending; sleeping 300" >&2
+       sleep 300 ;;
+    *) echo "r5 loop: suite aborted (device dead); sleeping 600" >&2
+       sleep 600 ;;
+  esac
+done
+echo "r5 loop: deadline reached" >&2

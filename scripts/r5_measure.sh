@@ -1,0 +1,381 @@
+#!/bin/bash
+# Round-5 hardware measurement suite — the r4 suite (which never got a live
+# device; measurements/r4.jsonl is two ABORT rows) re-armed with fresh
+# done/attempt files and the cheap tier re-ordered per VERDICT r4 weak #5:
+# the judge-facing evidence rows (SVD, ring schedules, MFU/traces, SIFT)
+# preempt the speculative narrow-tile experiments (ct4096/ct2048), which now
+# run after the scale tier. The wedge discipline is unchanged:
+#
+#   tier SAFE     the headline confirm (the one config proven on this chip:
+#                 twolevel/exact/high/8192 — r2 1.126 s, r3 0.983 s)
+#   tier CHEAP    pending judge-facing rows with no new kernel/trace risk
+#                 (SVD k-sweep, ring P=1 schedule+transfer-dtype timings,
+#                 distance-only MFU row)
+#   tier TRACE    the first-ever XProf captures (jax.profiler.trace is a
+#                 r3 wedge suspect; timed rows are durable BEFORE each
+#                 capture, so a trace wedge cannot eat them)
+#   tier SCALE    SIFT-100k, on-TPU test subset, 256k ring runs
+#   tier RISKY    everything that has wedged this chip or never run on it:
+#                 bf16 top-k keys, wide-top_k tile sweeps, approx_min_k
+#                 headline, SIFT-1M, Pallas variants. Gated by
+#                 RISKY_DEADLINE_EPOCH so a wedge here has hours to clear
+#                 before the driver's end-of-round bench needs the chip.
+#
+# Steps run SEQUENTIALLY (never two TPU processes), each behind a health
+# probe; completed steps are recorded in measurements/r5_done.txt so the
+# outer retry loop (scripts/r5_loop.sh) resumes instead of repeating.
+# A step that fails twice with a LIVE device is retired as FAILED so it
+# cannot starve later tiers. Results append to measurements/r5.jsonl the
+# moment they exist.
+#
+# Usage: bash scripts/r5_measure.sh [step ...]   (default: full r5 order)
+set -u
+# pipefail: run_step pipes the benched command through `tail -1`; without it
+# a watchdog-failed bench (prints its failure row, exits 2) would be banked
+# as a completed measurement and retired instead of retried
+set -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p measurements profiles
+OUT=measurements/r5.jsonl
+DONE=measurements/r5_done.txt
+ATTEMPTS=measurements/r5_attempts.txt
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-2}
+touch "$DONE" "$ATTEMPTS"
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+assert float((x @ x).sum()) == 256.0 * 256 * 256
+EOF
+}
+
+wait_alive() {
+  for i in $(seq 1 "${PROBE_RETRIES:-8}"); do
+    if past_deadline; then
+      echo "probe loop: past deadline, stopping" >&2
+      return 1
+    fi
+    probe && return 0
+    echo "probe $i: device unresponsive; waiting 120s" >&2
+    sleep 120
+  done
+  return 1
+}
+
+note() { echo "{\"step\": \"$1\", \"status\": \"$2\", \"ts\": \"$(date -Is)\"}" >> "$OUT"; }
+
+past_deadline() {
+  # DEADLINE_EPOCH: hard stop for STARTING steps — the driver needs the
+  # chip to itself for the end-of-round bench
+  [ -n "${DEADLINE_EPOCH:-}" ] && [ "$(date +%s)" -gt "$DEADLINE_EPOCH" ]
+}
+
+past_risky_deadline() {
+  [ -n "${RISKY_DEADLINE_EPOCH:-}" ] && \
+    [ "$(date +%s)" -gt "$RISKY_DEADLINE_EPOCH" ]
+}
+
+# Done/attempt bookkeeping is keyed by the STEP KEY ($KEY, set by the
+# dispatch loop) so the outer retry loop can compute "pending" directly
+# from the step list; jsonl rows keep the prettier per-measurement names.
+is_done() { grep -qx "$1" "$DONE"; }
+mark_done() { echo "$1" >> "$DONE"; }
+
+attempts_of() { grep -cx "$1" "$ATTEMPTS"; }
+
+# charge_attempt: returns 1 (and retires $KEY) once the step has already
+# burned MAX_ATTEMPTS live-device attempts
+charge_attempt() {
+  local n
+  n=$(attempts_of "$KEY")
+  if [ "$n" -ge "$MAX_ATTEMPTS" ]; then
+    note "$KEY" "RETIRED-after-$n-attempts"
+    mark_done "$KEY"
+    return 1
+  fi
+  echo "$KEY" >> "$ATTEMPTS"
+  return 0
+}
+
+# guard NAME [risky] — common preamble; returns 1 if the step should be
+# skipped, exits the suite on deadline/dead-device
+guard() {
+  local name=$1 tier=${2:-}
+  if is_done "$KEY"; then
+    return 1
+  fi
+  if past_deadline; then
+    echo "== $name: past deadline, yielding the device to the driver" >&2
+    exit 0
+  fi
+  if [ "$tier" = risky ] && past_risky_deadline; then
+    # permanent: the deadline only moves forward, so retire the step
+    note "$name" "SKIPPED-risky-deadline"
+    mark_done "$KEY"
+    echo "== $name: past risky deadline (wedge margin), skipping" >&2
+    return 1
+  fi
+  if ! wait_alive; then
+    # a dead transport will not heal mid-suite; abort and let the outer
+    # loop retry the whole suite after a long sleep
+    note "$name" "ABORT-device-dead"
+    echo "== $name: device dead, aborting suite" >&2
+    exit 1
+  fi
+  charge_attempt || return 1
+  echo "== $name" >&2
+  return 0
+}
+
+run_step() { # name tier timeout_s command...
+  local name=$1 tier=$2 tmo=$3; shift 3
+  guard "$name" "$tier" || return 0
+  local line
+  if line=$(timeout "$tmo" "$@" 2>>measurements/r5_steps.log | tail -1) \
+      && [ -n "$line" ]; then
+    echo "$line" | sed "s/^{/{\"step\": \"$name\", /" >> "$OUT"
+    mark_done "$KEY"
+  else
+    note "$name" "FAILED-or-timeout"
+  fi
+}
+
+run_report_step() { # name tier timeout_s report_file command...
+  local name=$1 tier=$2 tmo=$3 rep=$4; shift 4
+  guard "$name" "$tier" || return 0
+  rm -f "$rep"  # a stale report must not resurface as a fresh result
+  if timeout "$tmo" "$@" >/dev/null 2>>measurements/r5_steps.log \
+      && [ -f "$rep" ]; then
+    mark_done "$KEY"
+  else
+    rm -f "$rep"
+    note "$name" "FAILED-or-timeout"
+  fi
+}
+
+MFU_ROWS=measurements/mfu_rows.jsonl
+
+dist_s_flag() {  # "--dist-s X" once the r5 mfu_dist step has banked its row.
+  # Gated on the DONE marker, not mere file presence (ADVICE r3 #4: a
+  # skipped mfu_dist must not let later steps read a stale epoch's rows —
+  # here the marker only exists if this round's --fresh-jsonl run succeeded)
+  is_done mfu_dist || return 0
+  [ -f "$MFU_ROWS" ] || return 0
+  MFU_ROWS="$MFU_ROWS" python - <<'EOF' 2>/dev/null
+import json, os
+d = []
+for l in open(os.environ["MFU_ROWS"]):
+    try:  # a wedge-killed writer can leave a torn last line
+        r = json.loads(l)
+    except json.JSONDecodeError:
+        continue
+    if r.get("variant") == "distance-only":
+        d.append(r)
+if d:
+    print(f"--dist-s {d[-1]['median_s']}")
+EOF
+}
+
+STEPS="${*:-confirm \
+  svd1 svd10 svd100 \
+  ring_block ring_overlap ring_block_u ring_bf16x \
+  mfu_dist \
+  mfu_twolevel mfu_stream traces ring_ab \
+  sift100_l2_exact sift100_cos_exact sift100_l2_approx sift100_cos_approx \
+  ct4096 ct2048 \
+  tputests ring256k_exact ring256k_approx \
+  bf16topk bf16raw apxr90 apxr95 ct12288 ct16384 qt8192 approx95 \
+  sift1m_l2_exact sift1m_cos_exact sift1m_l2_approx sift1m_cos_approx \
+  pallas_tiles pallas_sweep traces2}"
+
+bench_env() {  # shared wedge-safe bench defaults; every knob overridable
+  # by env-prefixing the caller (e.g. BENCH_CT=4096 bench_env run_step ...)
+  BENCH_SCHEDULE="${BENCH_SCHEDULE:-twolevel}" \
+  BENCH_TOPK="${BENCH_TOPK:-exact}" \
+  BENCH_PRECISION="${BENCH_PRECISION:-high}" \
+  BENCH_CT="${BENCH_CT:-8192}" \
+  BENCH_WATCHDOG_S="${BENCH_WATCHDOG_S:-240}" "$@"
+}
+
+svd_step() {  # svd_step k
+  local k=$1
+  run_report_step "svd$k" cheap 600 "measurements/svd64_k$k.json" \
+    python -m mpi_knn_tpu --data mnist --svd 64 \
+    --k "$k" --loo -q --report "measurements/svd64_k$k.json"
+  [ -f "measurements/svd64_k$k.json" ] && \
+    ! grep -q "\"step\": \"svd64-k$k\"" "$OUT" && python - "$k" <<'EOF' >> "$OUT"
+import json, sys
+k = sys.argv[1]
+r = json.load(open(f"measurements/svd64_k{k}.json"))
+print(json.dumps({"step": f"svd64-k{k}", "phase_seconds": r["phase_seconds"],
+                  "accuracy": r.get("accuracy"), "backend": r["backend"]}))
+EOF
+}
+
+sift_step() {  # sift_step name tier m metric topk timeout watchdog
+  local name=$1 tier=$2 m=$3 mtr=$4 tk=$5 tmo=$6 wd=$7
+  run_step "$name" "$tier" "$tmo" python scripts/sift_bench.py \
+    --m "$m" --metric "$mtr" --topk "$tk" --watchdog-s "$wd"
+}
+
+aggregate_traces() {  # aggregate_traces stepname — host-side; silently a
+  # no-op until some trace exists (so retry passes don't spam the jsonl)
+  [ -d profiles/r5 ] || return 0
+  rm -f measurements/trace_ops_r5.json
+  if timeout 300 python scripts/trace_ops.py \
+      profiles/r5 --json measurements/trace_ops_r5.json \
+      >/dev/null 2>>measurements/r5_steps.log; then
+    note "$1" "written"
+    mark_done "$1"
+  else
+    note "$1" "FAILED-or-missing"
+  fi
+}
+
+for s in $STEPS; do KEY=$s; case $s in
+confirm)  # the r3-proven config; this row is the round's insurance policy
+  bench_env run_step confirm safe 300 python bench.py ;;
+ct4096)  # NARROWER corpus tiles: every prior sweep went wider
+  # (12288/16384); if per-tile lax.top_k cost grows superlinearly in
+  # width, narrower tiles + one more merge level could beat 8192. Same
+  # kernel risk profile as the proven confirm config (strictly narrower
+  # top_k), hence cheap tier
+  BENCH_CT=4096 bench_env run_step bench-ct4096 cheap 300 python bench.py ;;
+ct2048)
+  BENCH_CT=2048 bench_env run_step bench-ct2048 cheap 300 python bench.py ;;
+svd1) svd_step 1 ;;
+svd10) svd_step 10 ;;
+svd100) svd_step 100 ;;
+ring_block)  # VERDICT #7: ring-vs-serial overhead at P=1, blocking
+  BENCH_BACKEND=ring bench_env run_step ring-block-p1 cheap 420 \
+    python bench.py ;;
+ring_overlap)
+  BENCH_BACKEND=ring-overlap bench_env run_step ring-overlap-p1 cheap 420 \
+    python bench.py ;;
+ring_block_u)  # uncentered ring-block CONTROL row: pairs with ring_bf16x
+  # below so the cast-cost A/B differs in the transfer dtype ONLY (both
+  # uncentered; centering runs inside the timed region, so comparing
+  # bf16-xfer-uncentered against the centered ring_block would fold the
+  # centering pass into the "cast cost")
+  BENCH_BACKEND=ring BENCH_CENTER=0 bench_env \
+    run_step ring-block-p1-uncentered cheap 420 python bench.py ;;
+ring_bf16x)  # transfer-dtype cast cost (halved ICI bytes on real meshes).
+  # Uncentered: the cast rounds the LOCAL block too, so on centered data
+  # this mode can never pass the 0.999 recall gate (CPU-verified); raw
+  # integer pixels are bf16-exact, making the timing row meaningful
+  BENCH_BACKEND=ring BENCH_RING_XFER=bfloat16 BENCH_CENTER=0 bench_env \
+    run_step ring-bf16xfer-p1 cheap 420 python bench.py ;;
+mfu_dist)  # distance-only phase, own process — later variants can't lose it
+  run_step mfu_dist cheap 600 python scripts/profile_mfu.py \
+    --variants dist --precision high --append-jsonl "$MFU_ROWS" --fresh-jsonl
+  ;;
+mfu_twolevel)  # first-ever trace capture; timed row lands before the trace
+  is_done mfu_twolevel || rm -rf profiles/r5/twolevel
+  run_step mfu_twolevel trace 600 python scripts/profile_mfu.py \
+    --variants twolevel --precision high --profile-dir profiles/r5 \
+    --append-jsonl "$MFU_ROWS" $(dist_s_flag)
+  ;;
+mfu_stream)
+  is_done mfu_stream || rm -rf profiles/r5/stream
+  run_step mfu_stream trace 600 python scripts/profile_mfu.py \
+    --variants stream --precision high --profile-dir profiles/r5 \
+    --append-jsonl "$MFU_ROWS" $(dist_s_flag)
+  ;;
+traces)  # host-side aggregation of whatever traces exist so far
+  is_done traces || aggregate_traces traces ;;
+traces2)  # re-aggregate after the risky tier added Pallas/ring traces
+  is_done traces2 || aggregate_traces traces2 ;;
+ring_ab)  # VERDICT #3: the overlap-evidence artifact
+  if ! is_done ring_ab; then rm -rf profiles/ring_ab; fi
+  run_step ring_ab trace 900 python scripts/ring_ab.py --m 60000 --d 784 \
+    --k 10 --devices 1 --corpus-tile 8192 \
+    --profile-dir profiles/ring_ab --json measurements/ring_ab.json
+  if is_done ring_ab && [ ! -f measurements/trace_ops_ring_ab.json ]; then
+    if [ -d profiles/ring_ab ] && timeout 300 python scripts/trace_ops.py \
+        profiles/ring_ab --json measurements/trace_ops_ring_ab.json \
+        >/dev/null 2>>measurements/r5_steps.log; then
+      note trace-ops-ring-ab "written"
+    else
+      note trace-ops-ring-ab "FAILED-or-missing"
+    fi
+  fi ;;
+sift100_l2_exact)   sift_step sift100k-l2-exact     scale 900 100000 l2 exact 600 ;;
+sift100_cos_exact)  sift_step sift100k-cosine-exact scale 900 100000 cosine exact 600 ;;
+sift100_l2_approx)  sift_step sift100k-l2-approx    scale 900 100000 l2 approx 600 ;;
+sift100_cos_approx) sift_step sift100k-cosine-approx scale 900 100000 cosine approx 600 ;;
+tputests)
+  if ! is_done tputests && ! past_deadline && wait_alive \
+      && charge_attempt; then
+    echo "== tpu test subset" >&2
+    TKNN_TPU_TESTS=1 timeout 1800 python -m pytest tests/ -q \
+      > measurements/tpu_tests.txt 2>&1
+    tail -1 measurements/tpu_tests.txt | \
+      sed 's/^/{"step": "tputests", "result": "/; s/$/"}/' >> "$OUT"
+    if grep -q " passed" measurements/tpu_tests.txt \
+        && ! grep -q " failed" measurements/tpu_tests.txt; then
+      mark_done tputests
+    fi
+  fi ;;
+ring256k_exact|ring256k_approx)
+  tk=${s#ring256k_}
+  run_report_step "$s" scale 900 "measurements/ring256k_$tk.json" \
+    python -m mpi_knn_tpu --data sift:262144 \
+    --k 10 --backend ring --devices 1 --topk-method "$tk" \
+    --recall-vs-serial -q --report "measurements/ring256k_$tk.json"
+  [ -f "measurements/ring256k_$tk.json" ] && \
+    ! grep -q "\"step\": \"ring256k-$tk\"" "$OUT" && python - "$tk" <<'EOF' >> "$OUT"
+import json, sys
+tk = sys.argv[1]
+r = json.load(open(f"measurements/ring256k_{tk}.json"))
+print(json.dumps({"step": f"ring256k-{tk}", "phase_seconds": r["phase_seconds"],
+                  "recall_vs_baseline": r.get("recall_vs_baseline")}))
+EOF
+  ;;
+bf16topk)  # VERDICT #6 candidate A: half-width-key preselect
+  BENCH_TOPK=bf16 bench_env run_step bench-bf16-topk risky 300 \
+    python bench.py ;;
+bf16raw)  # uncentered integer data is bf16-exact; absolute zero-eps applies
+  BENCH_DTYPE=bfloat16 BENCH_CENTER=0 bench_env \
+    run_step bench-bf16-uncentered risky 300 python bench.py ;;
+ct12288)  # wider lax.top_k concats: the r1 wedge mode, scaled down
+  BENCH_CT=12288 bench_env run_step bench-ct12288 risky 300 python bench.py ;;
+ct16384)
+  BENCH_CT=16384 bench_env run_step bench-ct16384 risky 300 python bench.py ;;
+qt8192)
+  BENCH_QT=8192 bench_env run_step bench-qt8192 risky 300 python bench.py ;;
+approx95)  # approx_min_k wedged this chip in r3 — risky by evidence
+  BENCH_TOPK=approx BENCH_RT=0.95 bench_env \
+    run_step bench-approx-rt95 risky 300 python bench.py ;;
+apxr90)  # TPU-KNN paper recipe: overfetched approx preselect (rt=0.9,
+  # cheap partial reduction) + exact f32 rerank; the bench's fixed 0.999
+  # recall GATE still judges the measured result
+  BENCH_TOPK=approx-rerank BENCH_RT=0.90 bench_env \
+    run_step bench-apxr-rt90 risky 300 python bench.py ;;
+apxr95)
+  BENCH_TOPK=approx-rerank BENCH_RT=0.95 bench_env \
+    run_step bench-apxr-rt95 risky 300 python bench.py ;;
+sift1m_l2_exact)    sift_step sift1m-l2-exact      risky 2400 1000000 l2 exact 1800 ;;
+sift1m_cos_exact)   sift_step sift1m-cosine-exact  risky 2400 1000000 cosine exact 1800 ;;
+sift1m_l2_approx)   sift_step sift1m-l2-approx     risky 2400 1000000 l2 approx 1800 ;;
+sift1m_cos_approx)  sift_step sift1m-cosine-approx risky 2400 1000000 cosine approx 1800 ;;
+pallas_tiles)  # prime wedge suspect: dead last, own process, with trace
+  if ! is_done pallas_tiles; then rm -rf profiles/r5/pallas-tiles; fi
+  run_step pallas_tiles risky 600 python scripts/profile_mfu.py \
+    --variants pallas-tiles --precision high --profile-dir profiles/r5 \
+    --append-jsonl "$MFU_ROWS" $(dist_s_flag)
+  ;;
+pallas_sweep)
+  if ! is_done pallas_sweep; then rm -rf profiles/r5/pallas-sweep; fi
+  run_step pallas_sweep risky 600 python scripts/profile_mfu.py \
+    --variants pallas-sweep --precision high --profile-dir profiles/r5 \
+    --append-jsonl "$MFU_ROWS" $(dist_s_flag)
+  ;;
+*) echo "unknown step $s" >&2 ;;
+esac; done
+
+pending=0
+for s in $STEPS; do is_done "$s" || pending=$((pending + 1)); done
+echo "SUITE-PASS-COMPLETE pending=$pending -> $OUT" >&2
+[ "$pending" -eq 0 ] && exit 3   # nothing left: the loop can stop
+exit 0

@@ -179,3 +179,21 @@ def test_pallas_cosine_zero_row_falls_back_to_serial(rng, variant):
         np.asarray(pal.dists), np.asarray(ser.dists), rtol=1e-5, atol=1e-6
     )
     np.testing.assert_array_equal(np.asarray(pal.ids), np.asarray(ser.ids))
+
+
+def test_pallas_cosine_subclamp_row_falls_back_to_serial(rng, variant):
+    """A row with 0 < ||x||² <= _NORM_EPS is clamped (not unit-normalized)
+    by _l2_normalize, breaking the d² = 2·d_cos identity exactly like a
+    zero row — the degenerate-input guard must use the clamp threshold,
+    not an exact-zero test (r4 advisor finding)."""
+    X = _blobs(rng, m=96, d=16)
+    X[17] = 0.0
+    X[17, 0] = 1e-19  # ||x||² = 1e-38 <= _NORM_EPS, but != 0
+    pal = all_knn(X, k=5, backend="pallas", pallas_variant=variant,
+                  metric="cosine", query_tile=32, corpus_tile=64)
+    ser = all_knn(X, k=5, backend="serial", metric="cosine",
+                  query_tile=32, corpus_tile=64)
+    np.testing.assert_allclose(
+        np.asarray(pal.dists), np.asarray(ser.dists), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(pal.ids), np.asarray(ser.ids))
