@@ -237,6 +237,39 @@ COMPUTE_WITNESS = ("dot", "sort", "custom-call:TopK", "top-k", "topk",
                    "reduce")
 
 
+def property_holds(variant_reports: dict) -> bool:
+    """THE ring-overlap artifact property, single definition shared by
+    ``scripts/dump_ring_hlo.py`` (writes it into ``overlap_verdict.json``)
+    and ``tests/test_hlo_overlap.py`` (asserts it) — two hand-maintained
+    copies could drift and let the committed verdict disagree with the
+    test that is supposed to mirror it.
+
+    Input: ``{variant: {stage: permute_dependence_report(...)}}`` with
+    variants ``overlap``/``blocking`` and stages ``before_opt``/
+    ``after_opt``. Holds iff:
+
+    - overlap, BOTH stages: at least one collective-permute (zero would
+      make the checks vacuous), and none depends on any compute witness
+      or on an opt-barrier;
+    - blocking, before_opt: at least one collective-permute, and every
+      one depends on the opt-barrier AND the distance ``dot``. (After
+      optimization the barrier is legitimately expanded — cpu:
+      ``cse_barrier_expander`` — so after_opt makes no blocking claim.)
+    """
+    ok = True
+    for stage in ("before_opt", "after_opt"):
+        rep = variant_reports["overlap"][stage]
+        ok &= rep["n_collective_permute"] >= 1
+        for p in rep["permutes"]:
+            ok &= not p["compute_witnesses_in_slice"]
+            ok &= not p["depends_on_opt_barrier"]
+    rep = variant_reports["blocking"]["before_opt"]
+    ok &= rep["n_collective_permute"] >= 1
+    for p in rep["permutes"]:
+        ok &= bool(p["depends_on_opt_barrier"] and p["depends_on_dot"])
+    return bool(ok)
+
+
 def permute_dependence_report(text: str) -> dict:
     """For each collective-permute in the module: which compute-witness
     opcodes and how many opt-barriers its backward slice contains."""

@@ -54,9 +54,11 @@ sys.path.insert(0, str(REPO))  # run as `python scripts/dump_ring_hlo.py`
 def child(variant: str, dump_dir: str) -> None:
     """Runs in a subprocess: compile one schedule with HLO dumping on."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # our dump flags go LAST: XLA takes the last occurrence of a flag, so
+    # an inherited --xla_dump_to (a common debugging export) must not win
     os.environ["XLA_FLAGS"] = (
-        f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text "
-        + os.environ.get("XLA_FLAGS", "")
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
     )
     from mpi_knn_tpu.utils.platform import force_platform
 
@@ -102,7 +104,10 @@ def _pick(dump_dir: pathlib.Path, suffix: str) -> pathlib.Path:
 
 
 def main(out_dir: pathlib.Path) -> int:
-    from mpi_knn_tpu.utils.hlo_graph import permute_dependence_report
+    from mpi_knn_tpu.utils.hlo_graph import (
+        permute_dependence_report,
+        property_holds,
+    )
 
     out_dir.mkdir(parents=True, exist_ok=True)
     verdict: dict = {"source": "scripts/dump_ring_hlo.py", "variants": {}}
@@ -127,19 +132,8 @@ def main(out_dir: pathlib.Path) -> int:
         shutil.rmtree(dump_dir)
         verdict["variants"][variant] = stages
 
-    ok = True
-    for stage in ("before_opt", "after_opt"):
-        rep = verdict["variants"]["overlap"][stage]
-        # zero permutes would make the loops vacuously true — a dump with
-        # no collective at all must fail, not certify overlap freedom
-        ok &= rep["n_collective_permute"] >= 1
-        for p in rep["permutes"]:
-            ok &= not p["compute_witnesses_in_slice"]
-            ok &= not p["depends_on_opt_barrier"]
-    rep = verdict["variants"]["blocking"]["before_opt"]
-    ok &= rep["n_collective_permute"] >= 1
-    for p in rep["permutes"]:
-        ok &= p["depends_on_opt_barrier"] and p["depends_on_dot"]
+    # single shared definition — see hlo_graph.property_holds
+    ok = property_holds(verdict["variants"])
     verdict["property_holds"] = ok
     (out_dir / "overlap_verdict.json").write_text(
         json.dumps(verdict, indent=1) + "\n"

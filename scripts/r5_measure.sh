@@ -310,8 +310,13 @@ tputests)
     echo "== tpu test subset" >&2
     TKNN_TPU_TESTS=1 timeout 1800 python -m pytest tests/ -q \
       > measurements/tpu_tests.txt 2>&1
-    tail -1 measurements/tpu_tests.txt | \
-      sed 's/^/{"step": "tputests", "result": "/; s/$/"}/' >> "$OUT"
+    # json.dumps, not sed-wrapping: the pytest tail line can contain
+    # quotes/backslashes (exception reprs) that would corrupt the jsonl
+    python - <<'EOF' >> "$OUT"
+import json
+line = open("measurements/tpu_tests.txt").read().splitlines()[-1:]
+print(json.dumps({"step": "tputests", "result": line[0] if line else ""}))
+EOF
     if grep -q " passed" measurements/tpu_tests.txt \
         && ! grep -q " failed" measurements/tpu_tests.txt; then
       mark_done tputests

@@ -1,0 +1,152 @@
+"""CPU-mesh ring scaling table (VERDICT r4 #9): fixed total problem, the
+device count swept over the virtual CPU mesh.
+
+What a 1-core host with virtual devices can and cannot show:
+
+- CANNOT show speedup or ICI behavior — all "devices" timeshare one core
+  and collectives are memcpys. Absolute numbers here say nothing about the
+  TPU; the chip-side story is the r5 suite's ring steps.
+- CAN falsify redundant work: the ring does P rounds of (q_local × m/P)
+  compute per device, so TOTAL compute is P-invariant and on one core the
+  wall-clock must stay ~flat as P grows. A ring that forgot to shard, or
+  carried O(P²) overhead, shows up here as wall-time inflation with P.
+- CAN catch wrong rotations: the reference's ring did the SAME total work
+  but against the wrong blocks — own block twice, predecessor's never
+  (SURVEY.md Q1, ``/root/reference/mpi-knn-parallel_blocking.c:129-138``)
+  — invisible to timing, fatal to the bit-identity-to-serial assertion
+  this script runs at every P before timing.
+- CAN confirm the layout math: rounds == ring size, per-device rows ==
+  padded m / P.
+
+One subprocess per device count (the platform's device count is fixed at
+backend init). Rows append to the JSON output as they are measured.
+
+Usage: python scripts/ring_scaling_cpu.py [--out measurements/ring_scaling_cpu.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+M, D, K = 4096, 128, 10
+DEVICE_COUNTS = (1, 2, 4, 8)
+REPS = 5
+
+
+def child(n_devices: int, overlap: bool) -> None:
+    from mpi_knn_tpu.utils.platform import force_platform
+
+    force_platform("cpu", n_devices=n_devices)
+    import jax
+    import numpy as np
+
+    from mpi_knn_tpu.api import all_knn
+    from mpi_knn_tpu.backends.ring import parse_ring_mesh, ring_tiles
+    from mpi_knn_tpu.config import KNNConfig
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+    from mpi_knn_tpu.utils.timing import device_sync
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((M, D)).astype(np.float32)
+    backend = "ring-overlap" if overlap else "ring"
+    cfg = KNNConfig(k=K, backend=backend, query_tile=512, corpus_tile=512)
+    mesh = make_ring_mesh(n_devices)
+    _, _, dp, ring_n = parse_ring_mesh(mesh)
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, M, M, dp, ring_n)
+
+    # correctness at this P before timing it
+    res = all_knn(X, config=cfg, mesh=mesh)
+    ser = all_knn(X, k=K, backend="serial", query_tile=512, corpus_tile=512)
+    np.testing.assert_array_equal(np.asarray(res.ids), np.asarray(ser.ids))
+    np.testing.assert_array_equal(
+        np.asarray(res.dists), np.asarray(ser.dists)
+    )
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = all_knn(X, config=cfg, mesh=mesh)
+        device_sync(out.dists)
+        times.append(time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "devices": n_devices,
+                "backend": backend,
+                "rounds": ring_n,
+                "rows_per_device": c_pad // ring_n,
+                "q_tile": q_tile,
+                "c_tile": c_tile,
+                "median_s": round(statistics.median(times), 4),
+                "min_s": round(min(times), 4),
+                "reps": REPS,
+                "bit_identical_to_serial": True,
+            }
+        )
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--out", default=str(REPO / "measurements" / "ring_scaling_cpu.json")
+    )
+    args = ap.parse_args()
+    rows = []
+    for overlap in (False, True):
+        for n in DEVICE_COUNTS:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    __file__,
+                    "--child",
+                    str(n),
+                    "overlap" if overlap else "blocking",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=REPO,
+                timeout=1800,
+            )
+            if proc.returncode != 0:
+                print(proc.stderr[-2000:], file=sys.stderr)
+                return 1
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            rows.append(row)
+            print(json.dumps(row))
+            # durable after every row (wedge discipline habit, cheap here)
+            pathlib.Path(args.out).write_text(
+                json.dumps(
+                    {
+                        "problem": {"m": M, "d": D, "k": K},
+                        "host": "1-core x86_64, virtual CPU mesh — "
+                        "shape-of-scaling evidence only, not perf",
+                        "rows": rows,
+                    },
+                    indent=1,
+                )
+                + "\n"
+            )
+    flat = all(
+        r["median_s"] < 3.0 * rows[0]["median_s"] for r in rows
+    )  # loose: catches double-compute-with-P classes, tolerates 1-core noise
+    print(json.dumps({"total_work_flat_across_P": flat, "out": args.out}))
+    return 0 if flat else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), sys.argv[3] == "overlap")
+    else:
+        sys.exit(main())
