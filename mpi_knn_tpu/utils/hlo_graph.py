@@ -49,9 +49,10 @@ _INSTR_RE = re.compile(r"^\s+(ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
 class Instruction:
     name: str
     opcode: str
-    operands: list[str]  # %names used inside the operand parens
+    operands: list[str]  # %names used inside the operand parens (data)
     called: list[str]  # computations referenced from attributes
     attrs: str  # raw attribute text (custom_call_target etc.)
+    controls: list[str] = field(default_factory=list)  # control-predecessors
     param_index: int | None = None
     is_root: bool = False
 
@@ -138,9 +139,10 @@ def parse_hlo(text: str) -> HloModule:
         opcode, operand_text, attrs = _parse_rhs(rhs)
         # control-predecessors are scheduling edges, not dataflow — but for
         # "is the scheduler free to run these concurrently" they count
-        # exactly like operands (scheduled/post-opt TPU dumps emit them);
-        # folding them in only ADDS edges, preserving the stated
-        # over-approximation direction
+        # exactly like operands (scheduled/post-opt TPU dumps emit them).
+        # Kept SEPARATE from data operands so the tuple-element-precise
+        # traversal cannot accidentally drop them when it follows only one
+        # data element (they are pushed flat on every visit).
         control = [
             n
             for grp in _CONTROL_RE.findall(attrs)
@@ -149,7 +151,8 @@ def parse_hlo(text: str) -> HloModule:
         instr = Instruction(
             name=name,
             opcode=opcode,
-            operands=_NAME_RE.findall(operand_text) + control,
+            operands=_NAME_RE.findall(operand_text),
+            controls=control,
             called=_CALLED_RE.findall(attrs)
             + [
                 n
@@ -179,36 +182,113 @@ def _call_sites(module: HloModule) -> dict[str, list[tuple[str, str]]]:
     return sites
 
 
+_GTE_IDX_RE = re.compile(r"index=(\d+)")
+
+
 def backward_slice(
     module: HloModule, comp: str, name: str
 ) -> set[tuple[str, str]]:
     """Every (computation, instruction) the given instruction transitively
     depends on, crossing call boundaries in both directions (into called
-    computations via their roots; out of parameters via call sites)."""
+    computations via their roots; out of parameters via call sites).
+
+    Tuple-element precision: a ``get-tuple-element(t), index=i`` depends
+    on element i only, tracked as a pending index stack through ``tuple``
+    instructions and across ``parameter`` -> call-site hops. Without this,
+    a permute inside a scan's while body would drag the ENTIRE loop-init
+    tuple into its slice (every operand of the init, not just the block
+    element it actually reads) and report spurious compute witnesses.
+    The precision is still the correct scheduling model — an op starts
+    when its operand VALUES are ready, and a gte's value is its element —
+    with two deliberate flat exceptions where the op really does wait on
+    everything: ``opt-barrier`` (waiting on all operands is its entire
+    purpose) and instructions with called computations (a while's output
+    exists only after the whole body ran). Any shape the tracker does not
+    understand falls back to flat, so unknown patterns over-approximate
+    (adds paths) rather than hide dependence.
+
+    Two soundness details (both found by review, pinned in tests):
+
+    - Loop carries ARE modeled: a while-body parameter continues at the
+      body ROOT (same element index) as well as at the call-site init,
+      because at iteration j>0 the parameter's value is the previous
+      iteration's root element — a permute reading a compute-derived
+      carry element must not be certified dependence-free. The cycle this
+      creates terminates via the (comp, instr, index) visited set.
+    - ``control-predecessors`` edges are pushed flat on EVERY visit,
+      including the element-precise gte/tuple fast paths — they are
+      scheduling edges and must never be dropped by value tracking."""
     sites = _call_sites(module)
     seen: set[tuple[str, str]] = set()
-    work: list[tuple[str, str]] = [(comp, name)]
+    visited: set[tuple[str, str, tuple[int, ...]]] = set()
+    work: list[tuple[str, str, tuple[int, ...]]] = [(comp, name, ())]
     while work:
-        c, n = work.pop()
-        if (c, n) in seen or n not in module.computations[c].instructions:
+        c, n, idx = work.pop()
+        if (c, n, idx) in visited:
             continue
+        if n not in module.computations[c].instructions:
+            continue
+        visited.add((c, n, idx))
         seen.add((c, n))
         instr = module.instr(c, n)
+        for ctrl in instr.controls:  # scheduling edges: always, flat
+            work.append((c, ctrl, ()))
+
+        if instr.opcode == "get-tuple-element" and instr.operands:
+            m = _GTE_IDX_RE.search(instr.attrs)
+            if m:
+                work.append((c, instr.operands[0], (int(m.group(1)),) + idx))
+                continue
+        if instr.opcode == "tuple" and idx:
+            if idx[0] < len(instr.operands):
+                work.append((c, instr.operands[idx[0]], idx[1:]))
+                continue
+            # malformed index: fall through to flat
+
+        if instr.opcode == "parameter":
+            # keep the pending element index across the call boundary so a
+            # body parameter resolves to the matching init element
+            for sc, sn in sites.get(c, ()):
+                caller = module.instr(sc, sn)
+                pi = instr.param_index
+                if pi is not None and pi < len(caller.operands):
+                    work.append((sc, caller.operands[pi], idx))
+                else:  # comparator/arity mismatch: conservative, flat
+                    for o in caller.operands:
+                        work.append((sc, o, ()))
+                if caller.opcode == "while":
+                    # loop carry: at iteration j>0 this parameter is the
+                    # previous iteration's body-root element
+                    body = module.computations.get(c)
+                    if body and body.root:
+                        work.append((c, body.root, idx))
+            continue
+
+        if idx and instr.opcode == "call" and instr.called:
+            # pre-opt `call` boundaries vanish under inlining, so the
+            # call's output element IS the callee root's element — keep
+            # the pending index (the callee's dependence on the call
+            # operands still flows through its parameters). Without this,
+            # gte(call_result, k) falls to the flat branch and drags the
+            # WHOLE callee body (dot included) into every slice that
+            # crosses a call — e.g. the scan body's rotated-block element.
+            # `fusion` and `while` deliberately stay flat below: in the
+            # post-opt module they are real scheduling units whose outputs
+            # wait on the entire body.
+            for callee in instr.called:
+                callee_comp = module.computations.get(callee)
+                if callee_comp and callee_comp.root:
+                    work.append((callee, callee_comp.root, idx))
+            continue
+
+        # ordinary instruction (or opt-barrier / caller of computations):
+        # flat — all operands, whole called bodies
         for o in instr.operands:
-            work.append((c, o))
+            work.append((c, o, ()))
         for callee in instr.called:
             callee_comp = module.computations.get(callee)
             if callee_comp and callee_comp.root:
-                work.append((callee, callee_comp.root))
-        if instr.opcode == "parameter":
-            for sc, sn in sites.get(c, ()):
-                caller = module.instr(sc, sn)
-                idx = instr.param_index
-                if idx is not None and idx < len(caller.operands):
-                    work.append((sc, caller.operands[idx]))
-                else:  # while/comparator arity mismatch: conservative
-                    for o in caller.operands:
-                        work.append((sc, o))
+                work.append((callee, callee_comp.root, ()))
     return seen
 
 

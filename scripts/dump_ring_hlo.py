@@ -1,14 +1,13 @@
 """Produce the wedge-independent ring-overlap artifact (VERDICT r4 #2).
 
-Compiles ONE ring round (``backends.ring_resumable._ring_one_round`` — the
-production single-step jit, same ``step`` body as the scan driver) for both
-schedules on the virtual 8-device CPU mesh, and writes four HLO dumps plus
-a machine-checked verdict:
+Compiles BOTH production ring drivers — ``_ring_one_round`` (the resumable
+single-step jit) and ``_ring_knn_sharded`` (the headline ``lax.scan``
+driver; its permute lives inside the scan's while body) — for both
+schedules on the virtual 8-device CPU mesh, and writes eight HLO dumps
+plus a machine-checked verdict:
 
-    artifacts/hlo/ring_step_overlap.before_opt.hlo.txt
-    artifacts/hlo/ring_step_overlap.after_opt.hlo.txt
-    artifacts/hlo/ring_step_blocking.before_opt.hlo.txt
-    artifacts/hlo/ring_step_blocking.after_opt.hlo.txt
+    artifacts/hlo/ring_step_{overlap,blocking}.{before,after}_opt.hlo.txt
+    artifacts/hlo/ring_scan_{overlap,blocking}.{before,after}_opt.hlo.txt
     artifacts/hlo/overlap_verdict.json
 
 The structural property (checked by ``mpi_knn_tpu.utils.hlo_graph`` and
@@ -51,8 +50,10 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))  # run as `python scripts/dump_ring_hlo.py`
 
 
-def child(variant: str, dump_dir: str) -> None:
-    """Runs in a subprocess: compile one schedule with HLO dumping on."""
+def child(driver: str, variant: str, dump_dir: str) -> None:
+    """Runs in a subprocess: compile one schedule of one production driver
+    (``one_round`` = the resumable single-step jit, ``scan`` = the headline
+    lax.scan driver) with HLO dumping on."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     # our dump flags go LAST: XLA takes the last occurrence of a flag, so
     # an inherited --xla_dump_to (a common debugging export) must not win
@@ -65,7 +66,11 @@ def child(variant: str, dump_dir: str) -> None:
     force_platform("cpu", n_devices=8)
     import jax.numpy as jnp
 
-    from mpi_knn_tpu.backends.ring import parse_ring_mesh, ring_tiles
+    from mpi_knn_tpu.backends.ring import (
+        _ring_knn_sharded,
+        parse_ring_mesh,
+        ring_tiles,
+    )
     from mpi_knn_tpu.backends.ring_resumable import _ring_one_round
     from mpi_knn_tpu.config import KNNConfig
     from mpi_knn_tpu.ops.topk import init_topk
@@ -76,30 +81,40 @@ def child(variant: str, dump_dir: str) -> None:
     cfg = KNNConfig(k=4, query_tile=8, corpus_tile=16)
     m, nq, d = 128, 64, 32
     q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
-    args = (
+    overlap = variant == "overlap"
+    data = (
         jnp.zeros((q_pad, d), jnp.float32),
         jnp.zeros((q_pad,), jnp.int32),
         jnp.zeros((c_pad, d), jnp.float32),
         jnp.zeros((c_pad,), jnp.int32),
-        *init_topk(q_pad, cfg.k, dtype=jnp.float32),
     )
-    _ring_one_round.lower(
-        *args,
-        cfg,
-        variant == "overlap",
-        mesh,
-        axis,
-        q_tile,
-        c_tile,
-        q_axis=q_axis,
-        rotate=True,
-    ).compile()
+    if driver == "one_round":
+        _ring_one_round.lower(
+            *data,
+            *init_topk(q_pad, cfg.k, dtype=jnp.float32),
+            cfg,
+            overlap,
+            mesh,
+            axis,
+            q_tile,
+            c_tile,
+            q_axis=q_axis,
+            rotate=True,
+        ).compile()
+    else:
+        _ring_knn_sharded.lower(
+            *data, cfg, overlap, mesh, axis, q_tile, c_tile, q_axis=q_axis
+        ).compile()
 
 
-def _pick(dump_dir: pathlib.Path, suffix: str) -> pathlib.Path:
-    hits = sorted(dump_dir.glob(f"*jit__ring_one_round.{suffix}.txt"))
+def _pick(dump_dir: pathlib.Path, driver: str, suffix: str) -> pathlib.Path:
+    module = (
+        "jit__ring_one_round" if driver == "one_round"
+        else "jit__ring_knn_sharded"
+    )
+    hits = sorted(dump_dir.glob(f"*{module}.{suffix}.txt"))
     if not hits:
-        raise FileNotFoundError(f"no {suffix} dump in {dump_dir}")
+        raise FileNotFoundError(f"no {module} {suffix} dump in {dump_dir}")
     return hits[-1]
 
 
@@ -110,30 +125,46 @@ def main(out_dir: pathlib.Path) -> int:
     )
 
     out_dir.mkdir(parents=True, exist_ok=True)
-    verdict: dict = {"source": "scripts/dump_ring_hlo.py", "variants": {}}
-    for variant in ("overlap", "blocking"):
-        dump_dir = out_dir / f".dump_{variant}"
-        shutil.rmtree(dump_dir, ignore_errors=True)
-        dump_dir.mkdir(parents=True)
-        subprocess.run(
-            [sys.executable, __file__, "--child", variant, str(dump_dir)],
-            check=True,
-            cwd=REPO,
-        )
-        stages = {}
-        for stage, suffix in (
-            ("before_opt", "before_optimizations"),
-            ("after_opt", "cpu_after_optimizations"),
-        ):
-            src = _pick(dump_dir, suffix)
-            dst = out_dir / f"ring_step_{variant}.{stage}.hlo.txt"
-            shutil.copyfile(src, dst)
-            stages[stage] = permute_dependence_report(dst.read_text())
-        shutil.rmtree(dump_dir)
-        verdict["variants"][variant] = stages
+    # artifact file names: the single-round driver keeps its original
+    # "ring_step_" prefix; the scan driver dumps as "ring_scan_"
+    prefix = {"one_round": "ring_step", "scan": "ring_scan"}
+    verdict: dict = {"source": "scripts/dump_ring_hlo.py", "drivers": {}}
+    for driver in ("one_round", "scan"):
+        variants: dict = {}
+        for variant in ("overlap", "blocking"):
+            dump_dir = out_dir / f".dump_{driver}_{variant}"
+            shutil.rmtree(dump_dir, ignore_errors=True)
+            dump_dir.mkdir(parents=True)
+            subprocess.run(
+                [
+                    sys.executable,
+                    __file__,
+                    "--child",
+                    driver,
+                    variant,
+                    str(dump_dir),
+                ],
+                check=True,
+                cwd=REPO,
+            )
+            stages = {}
+            for stage, suffix in (
+                ("before_opt", "before_optimizations"),
+                ("after_opt", "cpu_after_optimizations"),
+            ):
+                src = _pick(dump_dir, driver, suffix)
+                dst = out_dir / f"{prefix[driver]}_{variant}.{stage}.hlo.txt"
+                shutil.copyfile(src, dst)
+                stages[stage] = permute_dependence_report(dst.read_text())
+            shutil.rmtree(dump_dir)
+            variants[variant] = stages
+        verdict["drivers"][driver] = variants
 
-    # single shared definition — see hlo_graph.property_holds
-    ok = property_holds(verdict["variants"])
+    # single shared definition — see hlo_graph.property_holds; the
+    # property must hold for BOTH production drivers
+    ok = all(
+        property_holds(variants) for variants in verdict["drivers"].values()
+    )
     verdict["property_holds"] = ok
     (out_dir / "overlap_verdict.json").write_text(
         json.dumps(verdict, indent=1) + "\n"
@@ -144,7 +175,7 @@ def main(out_dir: pathlib.Path) -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(sys.argv[2], sys.argv[3])
+        child(sys.argv[2], sys.argv[3], sys.argv[4])
     else:
         out = (
             pathlib.Path(sys.argv[1])
