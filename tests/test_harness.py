@@ -385,6 +385,61 @@ def test_cli_profile_writes_trace(tmp_path):
         trace_ops.parse_xplane(str(bad))
 
 
+def test_fold_round_renders_round_rows(tmp_path, capsys, monkeypatch):
+    """The round-end fold (measurements jsonl -> BASELINE-ready markdown)
+    has to work first try when hardware rows finally land: watchdog
+    sentinels must render as status not measurements, a torn mfu row (a
+    wedge can kill the writer mid-line) must be skipped with the LAST row
+    per variant kept, and the trace section must keep TPU planes while
+    dropping host/CPU planes (r4 advisor fix)."""
+    from scripts import fold_round
+
+    monkeypatch.setattr(fold_round, "MDIR", tmp_path)
+    monkeypatch.setattr(sys, "argv", ["fold_round.py", "r9"])
+    (tmp_path / "r9.jsonl").write_text(
+        '{"step": "confirm", "metric": "mnist60k_allknn_s", "value": 0.97,'
+        ' "unit": "s", "vs_baseline": 1.16, "recall": 1.0}\n'
+        '{"step": "bench-ct2048", "metric": "mnist60k_allknn_s",'
+        ' "value": 240, "unit": "s", "vs_baseline": 0.0, "failed": true}\n'
+        '{"step": "svd1", "status": "ABORT-device-dead", "ts": "t"}\n'
+    )
+    (tmp_path / "mfu_rows.jsonl").write_text(
+        '{"variant": "twolevel", "median_s": 9.9, "mfu_vs_bf16_peak": 0.01}\n'
+        '{"variant": "twolevel", "median_s": 1.0, "mfu_vs_bf16_peak": 0.029,'
+        ' "useful_tflop": 5.6, "peak_bf16_tflops": 197}\n'
+        '{"variant": "stream", "median_s": 1.2, "mfu_vs_'  # torn final line
+    )
+    (tmp_path / "trace_ops_r9.json").write_text(json.dumps({
+        "f.xplane.pb": {
+            "/device:CPU:0": {
+                "busy_ms_by_category": {"other": 1.0},
+                "collective_total_ms": 9.9,
+                "collective_overlapped_with_matmul_ms": 0.0,
+            },
+            "/device:TPU:0 (pid 1)": {
+                "busy_ms_by_category": {"matmul": 80.0, "collective": 8.0},
+                "collective_total_ms": 8.0,
+                "collective_overlapped_with_matmul_ms": 6.5,
+                "collective_span_ms": 9.0,
+                "collective_span_overlapped_with_matmul_ms": 7.0,
+            },
+        }
+    }))
+    assert fold_round.main() == 0
+    out = capsys.readouterr().out
+    assert "| confirm | mnist60k_allknn_s | 0.97 s | 1.16 |" in out
+    # the watchdog sentinel is a status line, never a measurement row
+    assert "| bench-ct2048 |" not in out
+    assert "WATCHDOG-FAILED at 240 s" in out
+    assert "ABORT-device-dead" in out
+    # last row per variant wins; the torn stream row is skipped entirely
+    assert "| twolevel | 1.0 s | 2.90 %" in out
+    assert "stream" not in out
+    # device story: TPU plane kept (with async span), CPU plane dropped
+    assert "/device:TPU:0" in out and "span-overlap 7.0" in out
+    assert "/device:CPU:0" not in out
+
+
 def test_trace_ops_parses_real_ring_trace(tmp_path):
     """End-to-end on REAL trace bytes (VERDICT r4 weak #4): capture an
     actual ring-overlap run under ``jax.profiler.trace`` on the 8-device
