@@ -1,0 +1,45 @@
+"""Static analysis of compiled programs — the framework's "catch it" layer.
+
+A rule engine over XLA HLO: every registered backend × metric × dtype
+configuration is lowered on CPU and a suite of static rules runs against
+the resulting def-use graph (see ``analysis/README.md`` and the rule
+docstrings in :mod:`mpi_knn_tpu.analysis.rules`). Grown from the
+single-purpose ring-overlap checker that caught a real sequencing bug in
+``backends/ring.py`` (VERDICT r5); the parsing core it was built on stays
+in :mod:`mpi_knn_tpu.utils.hlo_graph`.
+
+Entry points: ``mpi-knn lint`` (CLI), :func:`run_matrix` /
+:func:`lint_target` (programmatic), ``tests/test_hlo_lint.py`` (tier-1).
+"""
+
+from mpi_knn_tpu.analysis.engine import (
+    LintContext,
+    LintReport,
+    TargetResult,
+    lint_target,
+    run_matrix,
+    run_rules,
+)
+from mpi_knn_tpu.analysis.lowering import (
+    LintTarget,
+    UnsupportedTarget,
+    default_targets,
+    lower_target,
+)
+from mpi_knn_tpu.analysis.rules import RULES, Finding, property_holds
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "LintReport",
+    "LintTarget",
+    "RULES",
+    "TargetResult",
+    "UnsupportedTarget",
+    "default_targets",
+    "lint_target",
+    "lower_target",
+    "property_holds",
+    "run_matrix",
+    "run_rules",
+]

@@ -1,0 +1,111 @@
+"""``mpi-knn lint`` — run the static rule matrix and write the report.
+
+Exit status is the gate: 0 = every checked configuration passed every
+applicable rule, 1 = at least one finding (the JSON report carries the
+evidence), 2 = usage error. Runs entirely on CPU (virtual 8-device mesh),
+so it works on a laptop, in CI, and while the chip is dead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from mpi_knn_tpu.config import METRICS
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from mpi_knn_tpu.analysis.lowering import LINT_BACKENDS, LINT_DTYPES
+
+    p = argparse.ArgumentParser(
+        prog="mpi-knn lint",
+        description="statically lint every backend's compiled program "
+        "(HLO rule engine; CPU-only, no TPU needed)",
+    )
+    p.add_argument("--backend", action="append", choices=LINT_BACKENDS,
+                   help="restrict to backend(s); repeatable")
+    p.add_argument("--metric", action="append", choices=METRICS,
+                   help="restrict to metric(s); repeatable")
+    p.add_argument("--dtype", action="append", choices=LINT_DTYPES,
+                   help="restrict to dtype(s); repeatable")
+    p.add_argument("--rule", action="append", metavar="NAME",
+                   help="run only the named rule(s), e.g. R2-memory; "
+                   "repeatable")
+    p.add_argument("--out", default="artifacts/lint", metavar="DIR",
+                   help="report directory (default: artifacts/lint)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU device count for the ring mesh "
+                   "(default 8)")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from mpi_knn_tpu.analysis.rules import RULES
+
+        for r in RULES:
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    # platform first: lowering the ring matrix needs the virtual mesh, and
+    # the config knob must win before any device access (utils.platform)
+    from mpi_knn_tpu.utils.platform import force_platform
+
+    force_platform("cpu", n_devices=args.devices)
+
+    import jax
+
+    # the float64 column is the debug-precision mode; without x64 those
+    # lowerings would silently be float32 programs wearing an f64 label
+    jax.config.update("jax_enable_x64", True)
+
+    from mpi_knn_tpu.analysis.engine import run_matrix
+    from mpi_knn_tpu.analysis.lowering import default_targets
+
+    targets = [
+        t
+        for t in default_targets()
+        if (not args.backend or t.backend in args.backend)
+        and (not args.metric or t.metric in args.metric)
+        and (not args.dtype or t.dtype in args.dtype)
+    ]
+    if not targets:
+        print("error: no targets match the given filters", file=sys.stderr)
+        return 2
+
+    def progress(res):
+        if args.quiet:
+            return
+        if res.skipped is not None:
+            print(f"  SKIP {res.target.label}: {res.skipped}")
+        else:
+            state = "ok" if res.ok else f"{len(res.findings)} finding(s)"
+            print(f"  {res.target.label}: {state} "
+                  f"[{', '.join(res.rules_run)}]")
+
+    try:
+        report = run_matrix(targets, rule_names=args.rule, progress=progress)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    path = report.save(args.out)
+
+    if not args.quiet:
+        s = report.to_json()["summary"]
+        print(
+            f"lint: {s['targets_checked']} target(s) checked, "
+            f"{s['targets_skipped']} skipped, {s['findings']} finding(s); "
+            f"report: {path}"
+        )
+        for f in report.findings:
+            print(f"  VIOLATION [{f.rule}] {f.target} {f.stage}: {f.message}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""Lower every registered backend configuration to HLO text — on CPU, no
+TPU needed.
+
+The engine's subjects are the framework's own jitted cores, lowered with
+the exact static arguments the production wrappers would pass for a
+small-but-structured problem (multiple query tiles per device, multiple
+corpus tiles per ring block, a full 8-way ring on the virtual CPU mesh).
+Both pipeline stages are captured in-process from one lowering:
+
+- ``before_opt``: ``Lowered.compiler_ir("hlo").as_hlo_text()`` — the
+  module XLA receives (where the blocking barrier is still visible);
+- ``after_opt``: ``Compiled.as_text()`` — the module XLA will run (where
+  fusion/DCE/partitioning have had their say).
+
+No ``--xla_dump_to`` subprocess dance: the old artifact script needed one
+process per variant because dump flags are process-wide XLA_FLAGS; the
+in-process APIs have no such coupling, so the full matrix runs in one
+process and the results are cached per configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_knn_tpu.config import BACKENDS, METRICS, KNNConfig
+
+STAGES = ("before_opt", "after_opt")
+LINT_DTYPES = ("float32", "bfloat16", "float64")
+LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
+
+# Small but structurally faithful: 8 query tiles, 8 corpus tiles, an 8-way
+# ring with one (q_tile × c_tile) block tile per device per round — every
+# loop the production shapes have, at compile-in-seconds size.
+LINT_M, LINT_NQ, LINT_D, LINT_K = 128, 64, 32, 4
+LINT_QUERY_TILE, LINT_CORPUS_TILE = 8, 16
+
+
+@dataclasses.dataclass(frozen=True)
+class LintTarget:
+    """One cell of the backend × metric × dtype matrix."""
+
+    backend: str
+    metric: str
+    dtype: str
+
+    @property
+    def label(self) -> str:
+        return f"{self.backend}/{self.metric}/{self.dtype}"
+
+
+def default_targets() -> list[LintTarget]:
+    return [
+        LintTarget(b, m, d)
+        for b in LINT_BACKENDS
+        for m in METRICS
+        for d in LINT_DTYPES
+    ]
+
+
+class UnsupportedTarget(Exception):
+    """This configuration is rejected by the backend itself (a registered
+    restriction, not a lint failure) or cannot lower in this process."""
+
+
+def _base_cfg(target: LintTarget) -> KNNConfig:
+    return KNNConfig(
+        k=LINT_K,
+        metric=target.metric,
+        dtype=target.dtype,
+        query_tile=LINT_QUERY_TILE,
+        corpus_tile=LINT_CORPUS_TILE,
+    )
+
+
+def _acc_bytes(dtype: str) -> int:
+    return 8 if dtype == "float64" else 4
+
+
+def _require_x64(target: LintTarget) -> None:
+    if target.dtype == "float64" and not jax.config.jax_enable_x64:
+        # flipping the global here would silently change unrelated tracing
+        # in the host process; the lint CLI opts in explicitly instead
+        raise UnsupportedTarget(
+            "float64 targets need jax_enable_x64 (the lint CLI enables it; "
+            "in-process callers must opt in)"
+        )
+
+
+def hlo_texts(lowered) -> dict[str, str]:
+    """Both pipeline stages from one ``jax.stages.Lowered``."""
+    return {
+        "before_opt": lowered.compiler_ir(dialect="hlo").as_hlo_text(),
+        "after_opt": lowered.compile().as_text(),
+    }
+
+
+def _lower_serial(target: LintTarget):
+    from mpi_knn_tpu.backends.serial import (
+        effective_tiles,
+        knn_chunk_update,
+        prepare_tiles,
+    )
+    from mpi_knn_tpu.ops.topk import init_topk
+
+    _require_x64(target)
+    cfg = _base_cfg(target)
+    q_tile, c_tile = effective_tiles(cfg, LINT_M, LINT_NQ)
+    q_tiles, qid_tiles, c_tiles, c_tile_ids, q_pad = prepare_tiles(
+        np.zeros((LINT_M, LINT_D), np.float32),
+        np.zeros((LINT_NQ, LINT_D), np.float32),
+        np.full(LINT_NQ, -1, np.int32),
+        cfg,
+        q_tile,
+        c_tile,
+    )
+    acc = jnp.float64 if target.dtype == "float64" else jnp.float32
+    carry_d, carry_i = init_topk(q_pad, cfg.k, dtype=acc)
+    qt = q_pad // q_tile
+    lowered = knn_chunk_update.lower(
+        q_tiles,
+        qid_tiles,
+        c_tiles,
+        c_tile_ids,
+        carry_d.reshape(qt, q_tile, cfg.k),
+        carry_i.reshape(qt, q_tile, cfg.k),
+        cfg,
+    )
+    meta = {"q_tile": q_tile, "c_tile": c_tile,
+            "acc_bytes": _acc_bytes(target.dtype)}
+    return lowered, cfg, meta
+
+
+def _lower_ring(target: LintTarget):
+    from mpi_knn_tpu.backends.ring import (
+        _ring_knn_sharded,
+        parse_ring_mesh,
+        ring_tiles,
+    )
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+
+    _require_x64(target)
+    if len(jax.devices()) < 2:
+        raise UnsupportedTarget(
+            "ring targets need a multi-device mesh (force the CPU platform "
+            "with virtual devices first, as the lint CLI does)"
+        )
+    cfg = _base_cfg(target)
+    mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
+    q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, LINT_M, LINT_NQ, dp, ring_n)
+    dtype = jnp.dtype(cfg.dtype)
+    lowered = _ring_knn_sharded.lower(
+        jnp.zeros((q_pad, LINT_D), dtype),
+        jnp.zeros((q_pad,), jnp.int32),
+        jnp.zeros((c_pad, LINT_D), dtype),
+        jnp.zeros((c_pad,), jnp.int32),
+        cfg,
+        target.backend == "ring-overlap",
+        mesh,
+        axis,
+        q_tile,
+        c_tile,
+        q_axis=q_axis,
+    )
+    meta = {
+        "q_tile": q_tile,
+        "c_tile": c_tile,
+        "acc_bytes": _acc_bytes(target.dtype),
+        "ring_n": ring_n,
+        # the corpus block and its global-id row rotate together
+        "expected_permutes": 2,
+    }
+    return lowered, cfg, meta
+
+
+def _lower_pallas(target: LintTarget):
+    from mpi_knn_tpu.backends.pallas_backend import _pallas_all_knn
+    from mpi_knn_tpu.parallel.partition import pad_to_multiple
+
+    if target.dtype != "float32":
+        # mirrors all_knn_pallas's own ValueError — a registered
+        # restriction, recorded as skipped rather than silently shrunk
+        raise UnsupportedTarget(
+            "pallas backend computes in float32 only (its own wrapper "
+            "rejects other dtypes)"
+        )
+    cfg = _base_cfg(target)
+    # same tile policy as all_knn_pallas (MXU/VPU alignment + caps); cosine
+    # rides the L2 kernels on pre-normalized rows, so the lowered program
+    # is the L2 kernel either way and the metric needs no special casing
+    q_tile = min(max(8, pad_to_multiple(cfg.query_tile, 8)), 512,
+                 pad_to_multiple(LINT_NQ, 8))
+    c_tile = min(max(128, pad_to_multiple(cfg.corpus_tile, 128)), 2048,
+                 pad_to_multiple(LINT_M, 128))
+    c_pad = pad_to_multiple(LINT_M, c_tile)
+    q_pad = pad_to_multiple(LINT_NQ, q_tile)
+    lowered = _pallas_all_knn.lower(
+        jnp.zeros((q_pad, LINT_D), jnp.float32),
+        jnp.zeros((c_pad, LINT_D), jnp.float32),
+        cfg,
+        q_tile,
+        c_tile,
+        LINT_M,
+        False,
+        cfg.pallas_variant,
+    )
+    meta = {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4}
+    return lowered, cfg, meta
+
+
+_LOWERERS = {
+    "serial": _lower_serial,
+    "ring": _lower_ring,
+    "ring-overlap": _lower_ring,
+    "pallas": _lower_pallas,
+}
+
+
+@functools.lru_cache(maxsize=None)
+def lower_target(target: LintTarget):
+    """(texts_by_stage, cfg, meta) for one matrix cell, cached — the test
+    matrix and the CLI share lowerings within a process."""
+    try:
+        lowerer = _LOWERERS[target.backend]
+    except KeyError:
+        raise UnsupportedTarget(
+            f"no lowering registered for backend {target.backend!r}"
+        ) from None
+    lowered, cfg, meta = lowerer(target)
+    return hlo_texts(lowered), cfg, meta
+
+
+# ---------------------------------------------------------------------------
+# Ring-driver lowerings for the overlap artifact (scripts/dump_ring_hlo.py):
+# the resumable single-round jit alongside the headline scan driver, at the
+# artifact's historical shapes.
+
+
+def lower_ring_driver(driver: str, variant: str):
+    """HLO texts for one (driver, schedule) of the ring-overlap artifact.
+
+    ``driver``: ``"scan"`` (the headline ``lax.scan`` ring) or
+    ``"one_round"`` (the resumable single-round jit). ``variant``:
+    ``"overlap"`` or ``"blocking"``.
+    """
+    from mpi_knn_tpu.backends.ring import (
+        _ring_knn_sharded,
+        parse_ring_mesh,
+        ring_tiles,
+    )
+    from mpi_knn_tpu.backends.ring_resumable import _ring_one_round
+    from mpi_knn_tpu.ops.topk import init_topk
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+
+    mesh = make_ring_mesh(None)
+    q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
+    cfg = KNNConfig(k=4, query_tile=8, corpus_tile=16)
+    m, nq, d = LINT_M, LINT_NQ, LINT_D
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
+    overlap = variant == "overlap"
+    data = (
+        jnp.zeros((q_pad, d), jnp.float32),
+        jnp.zeros((q_pad,), jnp.int32),
+        jnp.zeros((c_pad, d), jnp.float32),
+        jnp.zeros((c_pad,), jnp.int32),
+    )
+    if driver == "one_round":
+        lowered = _ring_one_round.lower(
+            *data,
+            *init_topk(q_pad, cfg.k, dtype=jnp.float32),
+            cfg,
+            overlap,
+            mesh,
+            axis,
+            q_tile,
+            c_tile,
+            q_axis=q_axis,
+            rotate=True,
+        )
+    else:
+        lowered = _ring_knn_sharded.lower(
+            *data, cfg, overlap, mesh, axis, q_tile, c_tile, q_axis=q_axis
+        )
+    return hlo_texts(lowered)

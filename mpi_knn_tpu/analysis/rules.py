@@ -1,0 +1,544 @@
+"""Static rules over compiled-program structure (the lint engine's R1–R4).
+
+Each rule asserts one property of the HLO a backend configuration actually
+lowers/compiles to — the class of bug a timing run cannot surface (the
+reference's non-blocking variant "worked" for its whole life while
+serializing on MPI_Wait). The parsing core lives in
+``mpi_knn_tpu.utils.hlo_graph``; this module interprets the parsed graph.
+
+Shipped rules:
+
+- **R1-overlap** — comm/compute sequencing. The overlap schedule's
+  ``collective-permute`` must have no dependence path from the step's
+  distance compute (both before and after XLA optimization); the blocking
+  schedule's permutes must be sequenced after the compute via the
+  ``opt-barrier`` (before-opt only: XLA legitimately expands the barrier
+  mid-pipeline once it has constrained the passes it exists to constrain).
+- **R2-memory** — footprint bound. No instruction may define a buffer
+  larger than the tile budget implied by ``query_tile``/``corpus_tile``
+  (with slack for concatenated carries) or the largest input, whichever is
+  greater — statically forbidding accidental materialization of the full
+  m×m distance matrix.
+- **R3-dtype** — dtype integrity. In float64 debug mode no value may be
+  silently downcast (f64→f32/bf16/f16 ``convert``); in any mode a ``dot``
+  with bf16 operands must accumulate wider (bf16→bf16 dots lose the MXU's
+  f32 accumulator).
+- **R4-collective** — collective accounting. Ring backends must contain
+  exactly the expected corpus-rotation ``collective-permute`` pair with
+  ring-shaped ``source_target_pairs`` and nothing else; single-device
+  backends must contain no collectives at all (a stray ``all-gather`` /
+  ``all-reduce`` is a sharding leak).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from mpi_knn_tpu.utils.hlo_graph import (
+    HloModule,
+    backward_slice,
+    parse_hlo,
+    slice_opcodes,
+)
+
+# ---------------------------------------------------------------------------
+# Findings and rule protocol
+
+
+@dataclass
+class Finding:
+    """One rule violation, attributable to an instruction in one stage of
+    one lowered configuration."""
+
+    rule: str
+    target: str  # "backend/metric/dtype"
+    stage: str  # before_opt | after_opt
+    message: str
+    details: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "target": self.target,
+            "stage": self.stage,
+            "message": self.message,
+            "details": self.details,
+        }
+
+
+class Rule:
+    """A static check over one parsed HLO module.
+
+    ``applies`` gates on the configuration (a collective rule has nothing
+    to say about code it knows nothing about — it still runs on serial
+    programs, where "no collectives" IS the property); ``check`` returns
+    findings for one (stage, module) of a configuration that does apply.
+    """
+
+    name: str = ""
+    description: str = ""
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage: str, module: HloModule) -> list[Finding]:
+        raise NotImplementedError
+
+
+RULES: list[Rule] = []
+
+
+def register(cls):
+    RULES.append(cls())
+    return cls
+
+
+def rules_by_name(names=None) -> list[Rule]:
+    if names is None:
+        return list(RULES)
+    known = {r.name: r for r in RULES}
+    missing = [n for n in names if n not in known]
+    if missing:
+        raise KeyError(f"unknown rule(s) {missing}; have {sorted(known)}")
+    return [known[n] for n in names]
+
+
+# ---------------------------------------------------------------------------
+# R1: overlap/sequencing (the original ring-overlap artifact, generalized)
+
+# Opcodes that witness the ring step's distance/top-k compute. ``dot`` is
+# the MXU distance matmul; TopK/sort are the selection; reduce covers the
+# sq_norms/row-sum forms XLA sometimes prefers over dot pre-optimization.
+# Matched EXACTLY: prefix matching would classify the collective
+# ``reduce-scatter`` / data-movement ``reduce-window`` as compute and
+# falsely fail the overlap property on dumps with a second collective in
+# the permute's slice.
+COMPUTE_WITNESS = ("dot", "sort", "custom-call:TopK", "top-k", "topk",
+                   "reduce")
+
+
+def permute_dependence_report(text: str) -> dict:
+    """For each collective-permute in the module: which compute-witness
+    opcodes and how many opt-barriers its backward slice contains."""
+    return permute_report_from_module(parse_hlo(text))
+
+
+def permute_report_from_module(module: HloModule) -> dict:
+    permutes = module.find("collective-permute")
+    report = {
+        "n_collective_permute": len(permutes),
+        "n_opt_barrier_in_module": len(module.find("opt-barrier")),
+        "n_dot_in_module": len(module.find("dot")),
+        "permutes": [],
+    }
+    for comp, name in permutes:
+        sl = backward_slice(module, comp, name)
+        ops = slice_opcodes(module, sl)
+        report["permutes"].append(
+            {
+                "instruction": f"{comp}::{name}",
+                "slice_size": len(sl),
+                "depends_on_opt_barrier": "opt-barrier" in ops,
+                "compute_witnesses_in_slice": sorted(
+                    o for o in ops if o in COMPUTE_WITNESS
+                ),
+                "depends_on_dot": "dot" in ops,
+            }
+        )
+    return report
+
+
+def overlap_violations(rep: dict) -> list[str]:
+    """Why a permute-dependence report fails the OVERLAP schedule property
+    (empty = holds). Zero permutes is itself a violation — it would make
+    the dependence checks vacuous."""
+    out = []
+    if rep["n_collective_permute"] < 1:
+        out.append("no collective-permute in module (vacuous overlap claim)")
+    for p in rep["permutes"]:
+        if p["compute_witnesses_in_slice"]:
+            out.append(
+                f"{p['instruction']} depends on compute "
+                f"{p['compute_witnesses_in_slice']} — the transfer cannot "
+                "overlap the work it waits on"
+            )
+        if p["depends_on_opt_barrier"]:
+            out.append(
+                f"{p['instruction']} is sequenced behind an opt-barrier"
+            )
+    return out
+
+
+def blocking_violations(rep: dict) -> list[str]:
+    """Why a (before-opt) report fails the BLOCKING schedule property:
+    every permute must be sequenced after the compute via the barrier AND
+    see the distance dot in its slice."""
+    out = []
+    if rep["n_collective_permute"] < 1:
+        out.append("no collective-permute in module (vacuous blocking claim)")
+    for p in rep["permutes"]:
+        if not (p["depends_on_opt_barrier"] and p["depends_on_dot"]):
+            out.append(
+                f"{p['instruction']} is NOT sequenced after the compute "
+                "(missing opt-barrier/dot dependence) — 'blocking' would "
+                "silently be the overlap schedule"
+            )
+    return out
+
+
+def property_holds(variant_reports: dict) -> bool:
+    """THE ring-overlap artifact property, single definition shared by
+    ``scripts/dump_ring_hlo.py`` (writes it into ``overlap_verdict.json``),
+    ``tests/test_hlo_overlap.py`` (asserts it) and the engine's R1 rule —
+    hand-maintained copies could drift and let the committed verdict
+    disagree with the gate that is supposed to mirror it.
+
+    Input: ``{variant: {stage: permute_dependence_report(...)}}`` with
+    variants ``overlap``/``blocking`` and stages ``before_opt``/
+    ``after_opt``. Holds iff the overlap reports pass
+    :func:`overlap_violations` in BOTH stages and the blocking before-opt
+    report passes :func:`blocking_violations` (after optimization the
+    barrier is legitimately expanded — cpu: ``cse_barrier_expander`` — so
+    after_opt makes no blocking claim).
+    """
+    ok = not overlap_violations(variant_reports["overlap"]["before_opt"])
+    ok = ok and not overlap_violations(variant_reports["overlap"]["after_opt"])
+    ok = ok and not blocking_violations(
+        variant_reports["blocking"]["before_opt"]
+    )
+    return bool(ok)
+
+
+@register
+class R1Overlap(Rule):
+    name = "R1-overlap"
+    description = (
+        "ring schedules keep their sequencing contract: overlap permutes "
+        "are compute-independent, blocking permutes are barrier-sequenced"
+    )
+
+    def applies(self, ctx) -> bool:
+        return ctx.target.backend in ("ring", "ring-overlap")
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        rep = permute_report_from_module(module)
+        if ctx.target.backend == "ring-overlap":
+            why = overlap_violations(rep)
+        elif stage == "before_opt":
+            why = blocking_violations(rep)
+        else:  # blocking after-opt: barrier already expanded, no claim
+            return []
+        return [
+            Finding(self.name, ctx.target.label, stage, w,
+                    {"report": rep["permutes"]})
+            for w in why
+        ]
+
+
+# ---------------------------------------------------------------------------
+# R2: memory-footprint bound
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# Headroom over the (q_tile × c_tile) distance block for legitimate
+# intermediates: the (carry ‖ tile) concatenation before the merge top-k,
+# sort temporaries, and the twolevel survivor stack are all small multiples
+# of the tile. 4× holds across the whole shipped matrix with margin; a full
+# m×m materialization overshoots it by orders of magnitude.
+R2_SLACK = 4
+
+
+def max_buffer_bytes(type_str: str) -> int:
+    """Largest single buffer in an HLO result type. Tuples are per-element
+    buffers in XLA, so the max element — not the sum — is what an
+    instruction materializes at once."""
+    best = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        sz = _DTYPE_BYTES.get(dt)
+        if sz is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * sz)
+    return best
+
+
+def max_buffer_elems(type_str: str) -> int:
+    """Largest single buffer in an HLO result type, in ELEMENTS. The R2
+    budget is element-denominated: a bf16 input legitimately widens to the
+    f32 accumulation dtype (2× the input bytes), so byte-for-byte against
+    the inputs would flag every upcast."""
+    best = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n)
+    return best
+
+
+@register
+class R2Memory(Rule):
+    name = "R2-memory"
+    description = (
+        "no instruction defines a buffer beyond the query_tile×corpus_tile "
+        "budget (or the largest input) — no accidental m×m materialization"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        entry_params = [
+            i
+            for c in module.computations.values()
+            if c.is_entry
+            for i in c.instructions.values()
+            if i.opcode == "parameter"
+        ]
+        max_param = max(
+            (max_buffer_elems(i.type_str) for i in entry_params), default=0
+        )
+        tile_elems = ctx.meta["q_tile"] * ctx.meta["c_tile"]
+        acc_bytes = ctx.meta["acc_bytes"]
+        # element-denominated, then priced at the accumulation width: an
+        # input-sized buffer may widen to the accumulator dtype (bf16
+        # corpus → f32 norms path) but must not GROW in element count
+        budget = max(max_param, R2_SLACK * tile_elems) * acc_bytes
+        out = []
+        for c in module.computations.values():
+            for i in c.instructions.values():
+                if i.opcode == "parameter":
+                    continue  # inputs are the caller's bytes, not new ones
+                b = max_buffer_bytes(i.type_str)
+                if b > budget:
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} ({i.opcode}) materializes "
+                            f"{b} bytes > budget {budget} "
+                            f"(max(largest input {max_param} elems, "
+                            f"{R2_SLACK}×{ctx.meta['q_tile']}×"
+                            f"{ctx.meta['c_tile']} tile elems) × {acc_bytes} "
+                            "acc bytes)",
+                            {
+                                "bytes": b,
+                                "budget": budget,
+                                "type": i.type_str,
+                            },
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R3: dtype integrity
+
+
+def _result_dtype(type_str: str) -> str | None:
+    m = _SHAPE_RE.search(type_str)
+    return m.group(1) if m else None
+
+
+@register
+class R3Dtype(Rule):
+    name = "R3-dtype"
+    description = (
+        "no silent f64 downcast in float64 debug mode; bf16 dots must "
+        "accumulate in f32 or wider"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        out = []
+        check_f64 = ctx.target.dtype == "float64"
+        for c in module.computations.values():
+            for i in c.instructions.values():
+                res = _result_dtype(i.type_str)
+                if (
+                    check_f64
+                    and i.opcode == "convert"
+                    and res in ("f32", "bf16", "f16")
+                ):
+                    src = c.instructions.get(i.operands[0]) if i.operands else None
+                    if src is not None and _result_dtype(src.type_str) == "f64":
+                        out.append(
+                            Finding(
+                                self.name,
+                                ctx.target.label,
+                                stage,
+                                f"{c.name}::{i.name} silently converts f64 "
+                                f"-> {res} on the float64 debug path",
+                                {"type": i.type_str},
+                            )
+                        )
+                if i.opcode == "dot" and res == "bf16":
+                    op_dts = [
+                        _result_dtype(c.instructions[o].type_str)
+                        for o in i.operands
+                        if o in c.instructions
+                    ]
+                    if "bf16" in op_dts:
+                        out.append(
+                            Finding(
+                                self.name,
+                                ctx.target.label,
+                                stage,
+                                f"{c.name}::{i.name} is a bf16 dot without "
+                                "f32 accumulation (result bf16) — the MXU "
+                                "accumulator precision is being thrown away",
+                                {"type": i.type_str},
+                            )
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R4: collective accounting
+
+RING_COLLECTIVE = "collective-permute"
+STRAY_COLLECTIVES = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-broadcast",
+)
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+def count_collectives(module: HloModule) -> dict[str, list[tuple[str, str]]]:
+    """Collective instructions by canonical opcode. Async ``-start``/
+    ``-done`` pairs count once (the ``-start`` carries the semantics)."""
+    out: dict[str, list[tuple[str, str]]] = {}
+    for op in (RING_COLLECTIVE,) + STRAY_COLLECTIVES:
+        hits = [
+            (c, n)
+            for (c, n) in module.find(op)
+            if not module.instr(c, n).opcode.endswith("-done")
+        ]
+        if hits:
+            out[op] = hits
+    return out
+
+
+def _permute_pairs(module: HloModule, comp: str, name: str):
+    m = re.search(
+        r"source_target_pairs=\{(.*?)\}\}", module.instr(comp, name).attrs
+    )
+    if not m:
+        return None
+    return sorted(
+        (int(a), int(b)) for a, b in _PAIR_RE.findall(m.group(1) + "}")
+    )
+
+
+@register
+class R4Collectives(Rule):
+    name = "R4-collective"
+    description = (
+        "ring programs contain exactly the corpus-rotation permute pair "
+        "(ring-shaped source_target_pairs); single-device programs contain "
+        "no collectives — anything else is a sharding leak"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        found = count_collectives(module)
+        t = ctx.target
+        out = []
+        if t.backend not in ("ring", "ring-overlap"):
+            for op, hits in found.items():
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"single-device backend lowered a collective: "
+                        f"{len(hits)}× {op} ({hits[0][1]}, …) — sharding "
+                        "leak",
+                        {"op": op, "count": len(hits)},
+                    )
+                )
+            return out
+
+        for op in STRAY_COLLECTIVES:
+            if op in found:
+                hits = found[op]
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"ring program contains a stray {op} "
+                        f"({len(hits)}×, e.g. {hits[0][1]}) — a sharding "
+                        "leak would regather the corpus every round",
+                        {"op": op, "count": len(hits)},
+                    )
+                )
+        permutes = found.get(RING_COLLECTIVE, [])
+        expected = ctx.meta.get("expected_permutes")
+        if stage == "before_opt" and expected is not None:
+            if len(permutes) != expected:
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"expected exactly {expected} collective-permutes "
+                        f"(corpus block + ids rotation), found "
+                        f"{len(permutes)}",
+                        {"count": len(permutes)},
+                    )
+                )
+            ring_n = ctx.meta.get("ring_n")
+            want = (
+                sorted((i, (i + 1) % ring_n) for i in range(ring_n))
+                if ring_n
+                else None
+            )
+            for comp, name in permutes:
+                pairs = _permute_pairs(module, comp, name)
+                if want is not None and pairs is not None and pairs != want:
+                    out.append(
+                        Finding(
+                            self.name,
+                            t.label,
+                            stage,
+                            f"{comp}::{name} source_target_pairs {pairs} "
+                            f"is not the {ring_n}-ring rotation",
+                            {"pairs": pairs},
+                        )
+                    )
+        elif stage == "after_opt" and not permutes:
+            out.append(
+                Finding(
+                    self.name,
+                    t.label,
+                    stage,
+                    "ring program compiled to zero collective-permutes — "
+                    "the rotation was optimized away (results can only be "
+                    "correct if the corpus never moved, i.e. they are not)",
+                    {},
+                )
+            )
+        return out

@@ -58,6 +58,7 @@ from mpi_knn_tpu.parallel.partition import (
     pad_rows_any,
     pad_to_multiple,
 )
+from mpi_knn_tpu.utils.compat import axis_size, pcast_varying, shard_map
 
 
 def _ring_knn_local(
@@ -88,7 +89,7 @@ def _ring_knn_local(
     With ``single_round=True`` (the resumable driver,
     backends.ring_resumable) exactly one round runs and the rotated block is
     returned alongside the merged carry, so the host owns the round cursor."""
-    num_dev = jax.lax.axis_size(axis)
+    num_dev = axis_size(axis)
     # send to the next rank, wrap at the end — the reference's ring direction
     # (rank -> rank+1, mpi-knn-parallel_blocking.c:131)
     perm = [(i, (i + 1) % num_dev) for i in range(num_dev)]
@@ -118,8 +119,8 @@ def _ring_knn_local(
         # on a 2-D mesh, where per-device queries differ) so the scan carry
         # type is stable from step 0
         vary = tuple(vary_axes) or (axis,)
-        carry_d = jax.lax.pcast(carry_d, vary, to="varying")
-        carry_i = jax.lax.pcast(carry_i, vary, to="varying")
+        carry_d = pcast_varying(carry_d, vary)
+        carry_i = pcast_varying(carry_i, vary)
 
     def compute(blk, blk_ids, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
@@ -284,7 +285,7 @@ def _ring_knn_sharded(
     )
     qspec = _query_spec(q_axis, axis)
     cspec = P(axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(qspec, qspec, cspec, cspec),

@@ -42,6 +42,7 @@ from mpi_knn_tpu.parallel.partition import (
     pad_rows,
     pad_rows_any,
 )
+from mpi_knn_tpu.utils.compat import shard_map
 from mpi_knn_tpu.utils.logs import log
 from mpi_knn_tpu.utils.checkpoint import (
     KNNCheckpoint,
@@ -99,7 +100,7 @@ def _ring_one_round(
 
     qspec = _query_spec(q_axis, axis)
     cspec = P(axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(qspec, qspec, cspec, cspec, qspec, qspec),

@@ -16,7 +16,6 @@ Examples::
 from __future__ import annotations
 
 import argparse
-import json
 import re
 import sys
 
@@ -216,6 +215,15 @@ def _to_host(a) -> np.ndarray:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # static-analysis subcommand: lowers every backend's program on
+        # CPU and runs the HLO rule engine (mpi_knn_tpu.analysis). Routed
+        # before the run parser so the two flag namespaces stay disjoint.
+        from mpi_knn_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.save_every is not None and args.save_every <= 0:

@@ -1,0 +1,59 @@
+"""Shims over jax API drift, so the backends run on every jax this repo
+meets (the image pins one version; dev boxes and CI images lag or lead).
+
+Two surfaces moved between jax releases:
+
+- ``shard_map``: graduated from ``jax.experimental.shard_map`` to
+  ``jax.shard_map``. The experimental version also *checks replication
+  types* by default (``check_rep=True``) but has no way to mark a
+  replicated value as device-varying, so the graduated API's ``pcast``
+  idiom has no equivalent — we disable the check there instead (the
+  sharded out_specs still force the right physical layout).
+- ``jax.lax.pcast(x, axes, to="varying")``: exists only where
+  ``jax.shard_map`` does. On older jax it is a no-op (see above — the
+  replication check that would need it is off).
+
+Keep every version probe in this module: scattering ``hasattr(jax, ...)``
+probes through the backends is how version skew becomes untestable.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+
+if not _NEW_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` where available, else the experimental one with
+    the replication check off (no ``pcast`` exists to satisfy it)."""
+    if _NEW_SHARD_MAP:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    return _exp_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
+def axis_size(axis) -> int:
+    """``jax.lax.axis_size`` where it exists; else the classic static
+    ``psum(1, axis)`` idiom (jax folds a psum of a Python literal to the
+    axis size at trace time, so the result is usable as a scan length)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.lax.psum(1, axis)
+
+
+def pcast_varying(x, axes):
+    """Mark ``x`` device-varying over ``axes`` for shard_map's replication
+    checker; identity on jax versions whose checker is disabled (see
+    :func:`shard_map`)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, tuple(axes), to="varying")

@@ -11,13 +11,19 @@ tale: it *intended* overlap but MPI_Wait-ed before computing
 (``/root/reference/mpi-knn-parallel_non_blocking.c:229-233``), and nothing
 in its repo could have caught that — this module is the "catch it" layer.
 
-Scope: parses the classic HLO text format (one instruction per line,
+Scope: parses the HLO text format (one instruction per line,
 ``%name = type opcode(operands), attrs``) into a def-use graph with call
 edges (``to_apply``/``body``/``condition``/``calls``/
 ``called_computations``/``branch_computations``) and answers backward-
-reachability queries. The graph is *instruction-flat*: an instruction
-depends on all of its operands and on everything its called computations
-compute. That is exactly XLA's scheduling granularity (an op runs when its
+reachability queries. Both surface syntaxes are handled: the ``%``-prefixed
+classic form that ``--xla_dump_hlo_as_text`` and compiled executables
+print, and the bare-name form ``Lowered.compiler_ir("hlo").as_hlo_text()``
+emits (``dot.8 = f32[2,2]{1,0} dot(Arg_0.5, transpose.7)``, computation
+headers without parameter lists) — the lint engine
+(``mpi_knn_tpu.analysis``) lowers in-process and gets the latter.
+
+The graph is *instruction-flat*: an instruction depends on all of its
+operands and on everything its called computations compute. That is exactly XLA's scheduling granularity (an op runs when its
 operand instructions have produced values), so "no path" here is sound
 evidence that the scheduler is free to run the two ops concurrently.
 
@@ -41,18 +47,33 @@ _CALLED_SET_RE = re.compile(
     r"(?:called_computations|branch_computations)=\{([^}]*)\}"
 )
 _NAME_RE = re.compile(r"%([\w.\-]+)")
-_COMP_HDR_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(")
-_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+# bare-name form: identifiers start with a letter/underscore, so literal
+# operands (`constant(1)`, `parameter(0)`, `constant(false)` — "false" is
+# filtered by the unknown-name skip in backward_slice) never alias a real
+# instruction, and shape tokens never appear inside operand parens there
+_BARE_NAME_RE = re.compile(r"[A-Za-z_][\w.\-]*")
+_COMP_HDR_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*[({]")
+_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+
+
+def _names(text: str) -> list[str]:
+    """Instruction names referenced in an operand list or attribute group,
+    in either surface syntax: ``%``-prefixed names when any are present,
+    bare identifiers otherwise."""
+    if "%" in text:
+        return _NAME_RE.findall(text)
+    return _BARE_NAME_RE.findall(text)
 
 
 @dataclass
 class Instruction:
     name: str
     opcode: str
-    operands: list[str]  # %names used inside the operand parens (data)
+    operands: list[str]  # names used inside the operand parens (data)
     called: list[str]  # computations referenced from attributes
     attrs: str  # raw attribute text (custom_call_target etc.)
     controls: list[str] = field(default_factory=list)  # control-predecessors
+    type_str: str = ""  # raw result type text, e.g. "f32[4,8]{1,0}"
     param_index: int | None = None
     is_root: bool = False
 
@@ -97,11 +118,11 @@ def _skip_balanced(s: str, i: int) -> int:
     return len(s)
 
 
-def _parse_rhs(rhs: str) -> tuple[str, str, str]:
-    """Split an instruction's right-hand side into (opcode, operand_text,
-    attr_text). The type prefix is either a parenthesised tuple type or a
-    space-free token; the opcode is the identifier right before the operand
-    parens."""
+def _parse_rhs(rhs: str) -> tuple[str, str, str, str]:
+    """Split an instruction's right-hand side into (type_text, opcode,
+    operand_text, attr_text). The type prefix is either a parenthesised
+    tuple type or a space-free token; the opcode is the identifier right
+    before the operand parens."""
     i = 0
     rhs = rhs.strip()
     if rhs.startswith("("):  # tuple type
@@ -109,14 +130,15 @@ def _parse_rhs(rhs: str) -> tuple[str, str, str]:
     else:  # e.g. f32[8,16]{1,0} — no spaces
         while i < len(rhs) and not rhs[i].isspace():
             i += 1
+    type_text = rhs[:i]
     rest = rhs[i:].lstrip()
     m = re.match(r"([\w\-]+)\(", rest)
     if not m:
-        return rest.split("(")[0].strip(), "", ""
+        return type_text, rest.split("(")[0].strip(), "", ""
     opcode = m.group(1)
     start = m.end() - 1
     end = _skip_balanced(rest, start)
-    return opcode, rest[start + 1 : end - 1], rest[end:]
+    return type_text, opcode, rest[start + 1 : end - 1], rest[end:]
 
 
 def parse_hlo(text: str) -> HloModule:
@@ -128,7 +150,9 @@ def parse_hlo(text: str) -> HloModule:
             if m and line.rstrip().endswith("{"):
                 cur = Computation(name=m.group(2), is_entry=bool(m.group(1)))
             continue
-        if line.strip() == "}":
+        # computations close with "}" or "} // name" (some printers echo
+        # the computation name as a trailing comment)
+        if line.strip().startswith("}"):
             comps[cur.name] = cur
             cur = None
             continue
@@ -136,7 +160,7 @@ def parse_hlo(text: str) -> HloModule:
         if not m:
             continue
         is_root, name, rhs = bool(m.group(1)), m.group(2), m.group(3)
-        opcode, operand_text, attrs = _parse_rhs(rhs)
+        type_text, opcode, operand_text, attrs = _parse_rhs(rhs)
         # control-predecessors are scheduling edges, not dataflow — but for
         # "is the scheduler free to run these concurrently" they count
         # exactly like operands (scheduled/post-opt TPU dumps emit them).
@@ -146,20 +170,21 @@ def parse_hlo(text: str) -> HloModule:
         control = [
             n
             for grp in _CONTROL_RE.findall(attrs)
-            for n in _NAME_RE.findall(grp)
+            for n in _names(grp)
         ]
         instr = Instruction(
             name=name,
             opcode=opcode,
-            operands=_NAME_RE.findall(operand_text),
+            operands=_names(operand_text),
             controls=control,
             called=_CALLED_RE.findall(attrs)
             + [
                 n
                 for grp in _CALLED_SET_RE.findall(attrs)
-                for n in _NAME_RE.findall(grp)
+                for n in _names(grp)
             ],
             attrs=attrs,
+            type_str=type_text,
             is_root=is_root,
         )
         if opcode == "parameter":
@@ -306,73 +331,18 @@ def slice_opcodes(module: HloModule, sl: set[tuple[str, str]]) -> set[str]:
     return out
 
 
-# Opcodes that witness the ring step's distance/top-k compute. ``dot`` is
-# the MXU distance matmul; TopK/sort are the selection; reduce covers the
-# sq_norms/row-sum forms XLA sometimes prefers over dot pre-optimization.
-# Matched EXACTLY: prefix matching would classify the collective
-# ``reduce-scatter`` / data-movement ``reduce-window`` as compute and
-# falsely fail the overlap property on dumps with a second collective in
-# the permute's slice.
-COMPUTE_WITNESS = ("dot", "sort", "custom-call:TopK", "top-k", "topk",
-                   "reduce")
+def __getattr__(name):  # pragma: no cover - transitional import shim
+    # The overlap RULE (COMPUTE_WITNESS / permute_dependence_report /
+    # property_holds) moved to mpi_knn_tpu.analysis.rules when the
+    # single-purpose checker grew into the lint engine; this module is the
+    # parsing core only. Lazy so the analysis package (which imports this
+    # module) creates no cycle.
+    if name in (
+        "COMPUTE_WITNESS",
+        "permute_dependence_report",
+        "property_holds",
+    ):
+        from mpi_knn_tpu.analysis import rules as _rules
 
-
-def property_holds(variant_reports: dict) -> bool:
-    """THE ring-overlap artifact property, single definition shared by
-    ``scripts/dump_ring_hlo.py`` (writes it into ``overlap_verdict.json``)
-    and ``tests/test_hlo_overlap.py`` (asserts it) — two hand-maintained
-    copies could drift and let the committed verdict disagree with the
-    test that is supposed to mirror it.
-
-    Input: ``{variant: {stage: permute_dependence_report(...)}}`` with
-    variants ``overlap``/``blocking`` and stages ``before_opt``/
-    ``after_opt``. Holds iff:
-
-    - overlap, BOTH stages: at least one collective-permute (zero would
-      make the checks vacuous), and none depends on any compute witness
-      or on an opt-barrier;
-    - blocking, before_opt: at least one collective-permute, and every
-      one depends on the opt-barrier AND the distance ``dot``. (After
-      optimization the barrier is legitimately expanded — cpu:
-      ``cse_barrier_expander`` — so after_opt makes no blocking claim.)
-    """
-    ok = True
-    for stage in ("before_opt", "after_opt"):
-        rep = variant_reports["overlap"][stage]
-        ok &= rep["n_collective_permute"] >= 1
-        for p in rep["permutes"]:
-            ok &= not p["compute_witnesses_in_slice"]
-            ok &= not p["depends_on_opt_barrier"]
-    rep = variant_reports["blocking"]["before_opt"]
-    ok &= rep["n_collective_permute"] >= 1
-    for p in rep["permutes"]:
-        ok &= bool(p["depends_on_opt_barrier"] and p["depends_on_dot"])
-    return bool(ok)
-
-
-def permute_dependence_report(text: str) -> dict:
-    """For each collective-permute in the module: which compute-witness
-    opcodes and how many opt-barriers its backward slice contains."""
-    module = parse_hlo(text)
-    permutes = module.find("collective-permute")
-    report = {
-        "n_collective_permute": len(permutes),
-        "n_opt_barrier_in_module": len(module.find("opt-barrier")),
-        "n_dot_in_module": len(module.find("dot")),
-        "permutes": [],
-    }
-    for comp, name in permutes:
-        sl = backward_slice(module, comp, name)
-        ops = slice_opcodes(module, sl)
-        report["permutes"].append(
-            {
-                "instruction": f"{comp}::{name}",
-                "slice_size": len(sl),
-                "depends_on_opt_barrier": "opt-barrier" in ops,
-                "compute_witnesses_in_slice": sorted(
-                    o for o in ops if o in COMPUTE_WITNESS
-                ),
-                "depends_on_dot": "dot" in ops,
-            }
-        )
-    return report
+        return getattr(_rules, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Local CI gate — the one entry point future PRs run before pushing.
+#
+#   scripts/check.sh            # everything
+#   scripts/check.sh --fast     # skip the native sanitizer builds
+#
+# Order is cheapest-first so broken syntax fails in seconds, not after a
+# three-minute pytest run. Tools that may be absent in a given container
+# (ruff, mypy, a C++ toolchain) are SKIPPED with a notice, never silently:
+# the tier-1 pytest gate and compileall always run.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+fail=0
+note() { printf '\n== %s\n' "$*"; }
+
+note "compileall (syntax gate)"
+if ! python -m compileall -q mpi_knn_tpu tests scripts; then
+    fail=1
+fi
+
+note "ruff (pyproject.toml [tool.ruff])"
+if command -v ruff >/dev/null 2>&1; then
+    ruff check mpi_knn_tpu tests scripts || fail=1
+else
+    echo "SKIP: ruff not installed (pip install -e .[dev])"
+fi
+
+note "mypy (pyproject.toml [tool.mypy])"
+if command -v mypy >/dev/null 2>&1; then
+    mypy || fail=1
+else
+    echo "SKIP: mypy not installed (pip install -e .[dev])"
+fi
+
+if [ "$FAST" = 0 ]; then
+    note "native sanitizer builds (asan + ubsan)"
+    if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+        make -C native asan ubsan || fail=1
+    else
+        echo "SKIP: no C++ toolchain (\$CXX/g++)"
+    fi
+fi
+
+note "static lint of every backend's compiled program (mpi-knn lint)"
+python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
+
+note "tier-1 pytest (the ROADMAP.md gate)"
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+[ "$rc" -ne 0 ] && fail=1
+
+note "result"
+if [ "$fail" -ne 0 ]; then
+    echo "CHECK FAILED"
+    exit 1
+fi
+echo "CHECK OK"

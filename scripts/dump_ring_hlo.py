@@ -10,8 +10,9 @@ plus a machine-checked verdict:
     artifacts/hlo/ring_scan_{overlap,blocking}.{before,after}_opt.hlo.txt
     artifacts/hlo/overlap_verdict.json
 
-The structural property (checked by ``mpi_knn_tpu.utils.hlo_graph`` and
-asserted in ``tests/test_hlo_overlap.py``):
+The structural property (checked by ``mpi_knn_tpu.analysis.rules`` over
+the ``mpi_knn_tpu.utils.hlo_graph`` def-use graph and asserted in
+``tests/test_hlo_overlap.py``):
 
 - overlap=True: every ``collective-permute``'s backward slice is free of
   the step's compute (no ``dot``, no top-k) — before AND after XLA's
@@ -33,93 +34,29 @@ shows it; the before-opt dump is the sequencing artifact. On TPU the
 runtime confirmation is the XProf A/B trace (scripts/ring_ab.py) — pending
 a live chip; BASELINE.md's evidence ledger tracks that separately.
 
-Each variant compiles in its own subprocess because --xla_dump_to is a
-process-wide XLA_FLAGS knob parsed once.
+Historical note: this used to fork one subprocess per variant because
+``--xla_dump_to`` is a process-wide XLA_FLAGS knob. The shared lint-engine
+lowering (``mpi_knn_tpu.analysis.lowering``) captures both stages
+in-process, so the whole artifact now regenerates in one process.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import pathlib
-import shutil
-import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))  # run as `python scripts/dump_ring_hlo.py`
 
 
-def child(driver: str, variant: str, dump_dir: str) -> None:
-    """Runs in a subprocess: compile one schedule of one production driver
-    (``one_round`` = the resumable single-step jit, ``scan`` = the headline
-    lax.scan driver) with HLO dumping on."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    # our dump flags go LAST: XLA takes the last occurrence of a flag, so
-    # an inherited --xla_dump_to (a common debugging export) must not win
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
-    )
+def main(out_dir: pathlib.Path) -> int:
     from mpi_knn_tpu.utils.platform import force_platform
 
     force_platform("cpu", n_devices=8)
-    import jax.numpy as jnp
 
-    from mpi_knn_tpu.backends.ring import (
-        _ring_knn_sharded,
-        parse_ring_mesh,
-        ring_tiles,
-    )
-    from mpi_knn_tpu.backends.ring_resumable import _ring_one_round
-    from mpi_knn_tpu.config import KNNConfig
-    from mpi_knn_tpu.ops.topk import init_topk
-    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
-
-    mesh = make_ring_mesh(8)
-    q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
-    cfg = KNNConfig(k=4, query_tile=8, corpus_tile=16)
-    m, nq, d = 128, 64, 32
-    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
-    overlap = variant == "overlap"
-    data = (
-        jnp.zeros((q_pad, d), jnp.float32),
-        jnp.zeros((q_pad,), jnp.int32),
-        jnp.zeros((c_pad, d), jnp.float32),
-        jnp.zeros((c_pad,), jnp.int32),
-    )
-    if driver == "one_round":
-        _ring_one_round.lower(
-            *data,
-            *init_topk(q_pad, cfg.k, dtype=jnp.float32),
-            cfg,
-            overlap,
-            mesh,
-            axis,
-            q_tile,
-            c_tile,
-            q_axis=q_axis,
-            rotate=True,
-        ).compile()
-    else:
-        _ring_knn_sharded.lower(
-            *data, cfg, overlap, mesh, axis, q_tile, c_tile, q_axis=q_axis
-        ).compile()
-
-
-def _pick(dump_dir: pathlib.Path, driver: str, suffix: str) -> pathlib.Path:
-    module = (
-        "jit__ring_one_round" if driver == "one_round"
-        else "jit__ring_knn_sharded"
-    )
-    hits = sorted(dump_dir.glob(f"*{module}.{suffix}.txt"))
-    if not hits:
-        raise FileNotFoundError(f"no {module} {suffix} dump in {dump_dir}")
-    return hits[-1]
-
-
-def main(out_dir: pathlib.Path) -> int:
-    from mpi_knn_tpu.utils.hlo_graph import (
+    from mpi_knn_tpu.analysis.lowering import lower_ring_driver
+    from mpi_knn_tpu.analysis.rules import (
         permute_dependence_report,
         property_holds,
     )
@@ -132,35 +69,16 @@ def main(out_dir: pathlib.Path) -> int:
     for driver in ("one_round", "scan"):
         variants: dict = {}
         for variant in ("overlap", "blocking"):
-            dump_dir = out_dir / f".dump_{driver}_{variant}"
-            shutil.rmtree(dump_dir, ignore_errors=True)
-            dump_dir.mkdir(parents=True)
-            subprocess.run(
-                [
-                    sys.executable,
-                    __file__,
-                    "--child",
-                    driver,
-                    variant,
-                    str(dump_dir),
-                ],
-                check=True,
-                cwd=REPO,
-            )
+            texts = lower_ring_driver(driver, variant)
             stages = {}
-            for stage, suffix in (
-                ("before_opt", "before_optimizations"),
-                ("after_opt", "cpu_after_optimizations"),
-            ):
-                src = _pick(dump_dir, driver, suffix)
+            for stage, text in texts.items():
                 dst = out_dir / f"{prefix[driver]}_{variant}.{stage}.hlo.txt"
-                shutil.copyfile(src, dst)
-                stages[stage] = permute_dependence_report(dst.read_text())
-            shutil.rmtree(dump_dir)
+                dst.write_text(text)
+                stages[stage] = permute_dependence_report(text)
             variants[variant] = stages
         verdict["drivers"][driver] = variants
 
-    # single shared definition — see hlo_graph.property_holds; the
+    # single shared definition — see analysis.rules.property_holds; the
     # property must hold for BOTH production drivers
     ok = all(
         property_holds(variants) for variants in verdict["drivers"].values()
@@ -174,12 +92,9 @@ def main(out_dir: pathlib.Path) -> int:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(sys.argv[2], sys.argv[3], sys.argv[4])
-    else:
-        out = (
-            pathlib.Path(sys.argv[1])
-            if len(sys.argv) > 1
-            else REPO / "artifacts" / "hlo"
-        )
-        sys.exit(main(out))
+    out = (
+        pathlib.Path(sys.argv[1])
+        if len(sys.argv) > 1
+        else REPO / "artifacts" / "hlo"
+    )
+    sys.exit(main(out))

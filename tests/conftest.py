@@ -67,3 +67,17 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def debug_nans():
+    """``jax_debug_nans`` on for one test, restored unconditionally. As a
+    fixture (not an in-test try/finally) a crash anywhere in the test body
+    — including during collection-time fixture setup — can never leak the
+    flag into later tests, where it would silently recompile every jit
+    with NaN checks and distort timings."""
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -1,0 +1,150 @@
+"""Direct unit tests for the ``utils.hlo_graph`` parser — the parsing core
+under the lint engine. The overlap test exercises it end-to-end; these pin
+the grammar corners on their own: ``control-predecessors``,
+``branch_computations``, multi-computation ``calls``, the two surface
+syntaxes (``%``-prefixed dump format vs the bare-name
+``compiler_ir("hlo")`` format), ``} // name`` computation closers, and the
+result-type capture the memory rule depends on."""
+
+from mpi_knn_tpu.utils.hlo_graph import backward_slice, parse_hlo
+
+_BRANCHY = """\
+HloModule branchy, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+
+%big.1 (bp.1: f32[8]) -> f32[8] {
+  %bp.1 = f32[8]{0} parameter(0)
+  ROOT %bd.1 = f32[8]{0} multiply(%bp.1, %bp.1)
+}
+
+%small.1 (sp.1: f32[8]) -> f32[8] {
+  %sp.1 = f32[8]{0} parameter(0)
+  ROOT %sd.1 = f32[8]{0} add(%sp.1, %sp.1)
+}
+
+%helper.1 (hp.1: f32[8]) -> f32[8] {
+  %hp.1 = f32[8]{0} parameter(0)
+  ROOT %hr.1 = f32[8]{0} negate(%hp.1)
+}
+
+ENTRY %main.1 (a.1: f32[8], i.1: s32[]) -> f32[8] {
+  %a.1 = f32[8]{0} parameter(0)
+  %i.1 = s32[] parameter(1)
+  %c.1 = f32[8]{0} conditional(%i.1, %a.1, %a.1), branch_computations={%big.1, %small.1}
+  %cc.1 = f32[8]{0} custom-call(%c.1), custom_call_target="fake", called_computations={%helper.1, %big.1}
+  ROOT %r.1 = f32[8]{0} add(%c.1, %cc.1)
+}
+"""
+
+
+def test_branch_computations_and_called_computations_sets():
+    """Both set-valued attribute forms create call edges: a conditional's
+    ``branch_computations`` and a custom-call's ``called_computations``
+    (each possibly multi-computation)."""
+    m = parse_hlo(_BRANCHY)
+    assert set(m.computations) == {"big.1", "small.1", "helper.1", "main.1"}
+    cond = m.instr("main.1", "c.1")
+    assert cond.called == ["big.1", "small.1"]
+    cc = m.instr("main.1", "cc.1")
+    assert cc.called == ["helper.1", "big.1"]
+    # the slice of the root reaches through BOTH branches and the helper
+    sl = backward_slice(m, "main.1", "r.1")
+    comps = {c for c, _ in sl}
+    assert {"big.1", "small.1", "helper.1"} <= comps
+
+
+def test_control_predecessors_parse_and_count_as_edges():
+    mod = """\
+HloModule ctrl, entry_computation_layout={(f32[4]{0})->f32[4]{0}}
+
+ENTRY %e.1 (p.1: f32[4]) -> f32[4] {
+  %p.1 = f32[4]{0} parameter(0)
+  %x.1 = f32[4]{0} multiply(%p.1, %p.1)
+  %y.1 = f32[4]{0} add(%p.1, %p.1), control-predecessors={%x.1}
+  ROOT %r.1 = f32[4]{0} negate(%y.1)
+}
+"""
+    m = parse_hlo(mod)
+    y = m.instr("e.1", "y.1")
+    assert y.controls == ["x.1"]
+    assert ("e.1", "x.1") in backward_slice(m, "e.1", "y.1")
+
+
+_BARE = """\
+HloModule bare, entry_computation_layout={(f32[4,8]{1,0})->f32[4,4]{1,0}}
+
+region_0.1 {
+  Arg_0.2 = f32[4,8]{1,0} parameter(0)
+  transpose.3 = f32[8,4]{0,1} transpose(Arg_0.2), dimensions={1,0}
+  ROOT dot.4 = f32[4,4]{1,0} dot(Arg_0.2, transpose.3), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+} // region_0.1
+
+ENTRY main.5 {
+  a.6 = f32[4,8]{1,0} parameter(0)
+  call.7 = f32[4,4]{1,0} call(a.6), to_apply=region_0.1
+  constant.8 = f32[] constant(1)
+  broadcast.9 = f32[4,4]{1,0} broadcast(constant.8), dimensions={}
+  ROOT add.10 = f32[4,4]{1,0} add(call.7, broadcast.9)
+}
+"""
+
+
+def test_bare_name_format_and_comment_closers():
+    """The ``compiler_ir("hlo")`` surface syntax: no ``%`` prefixes, headers
+    without parameter lists, computations closed by ``} // name``. The old
+    parser silently swallowed everything after the first commented closer —
+    which is how a whole dump once reported zero collective-permutes."""
+    m = parse_hlo(_BARE)
+    assert set(m.computations) == {"region_0.1", "main.5"}
+    assert m.computations["main.5"].is_entry
+    call = m.instr("main.5", "call.7")
+    assert call.operands == ["a.6"]
+    assert call.called == ["region_0.1"]
+    # literal operands (constant(1), parameter(0)) must not become edges
+    assert m.instr("main.5", "constant.8").operands == []
+    sl = backward_slice(m, "main.5", "add.10")
+    assert ("region_0.1", "dot.4") in sl
+
+
+def test_result_types_captured_for_shape_accounting():
+    m = parse_hlo(_BARE)
+    assert m.instr("main.5", "a.6").type_str == "f32[4,8]{1,0}"
+    assert m.instr("main.5", "constant.8").type_str == "f32[]"
+    mt = parse_hlo(
+        """\
+HloModule t, entry_computation_layout={(f32[2]{0})->(f32[2]{0}, s32[2]{0})}
+
+ENTRY %e.1 (p.1: f32[2]) -> (f32[2], s32[2]) {
+  %p.1 = f32[2]{0} parameter(0)
+  %i.1 = s32[2]{0} convert(%p.1)
+  ROOT %t.1 = (f32[2]{0}, s32[2]{0}) tuple(%p.1, %i.1)
+}
+"""
+    )
+    assert mt.instr("e.1", "t.1").type_str == "(f32[2]{0}, s32[2]{0})"
+
+
+def test_multi_computation_calls_share_one_callee():
+    """Two call sites into the same computation: a parameter must continue
+    at BOTH call sites (the conservative over-approximation documented in
+    the module docstring)."""
+    mod = """\
+HloModule twocalls, entry_computation_layout={(f32[4]{0}, f32[4]{0})->f32[4]{0}}
+
+%inner.1 (p.1: f32[4]) -> f32[4] {
+  %p.1 = f32[4]{0} parameter(0)
+  ROOT %d.1 = f32[4]{0} multiply(%p.1, %p.1)
+}
+
+ENTRY %main.1 (a.1: f32[4], b.1: f32[4]) -> f32[4] {
+  %a.1 = f32[4]{0} parameter(0)
+  %b.1 = f32[4]{0} parameter(1)
+  %c1.1 = f32[4]{0} call(%a.1), to_apply=%inner.1
+  %c2.1 = f32[4]{0} call(%b.1), to_apply=%inner.1
+  ROOT %r.1 = f32[4]{0} add(%c1.1, %c2.1)
+}
+"""
+    m = parse_hlo(mod)
+    # slicing from inside the callee reaches both callers' operands
+    sl = backward_slice(m, "inner.1", "d.1")
+    names = {n for _, n in sl}
+    assert {"a.1", "b.1"} <= names

@@ -1,0 +1,299 @@
+"""The lint engine's tier-1 gate (ISSUE 1 tentpole).
+
+Three layers:
+
+- the FULL backend × metric × dtype rule matrix runs clean on the current
+  code (every parametrized cell lowers on the 8-device CPU mesh and passes
+  every applicable rule; pallas's float32-only restriction is a registered
+  skip, not a silent hole);
+- each rule catches its injected counterexample through the exact
+  production rule path (``engine.run_rules``): R2 a deliberately de-tiled
+  lowering that materializes the full distance matrix, R4 an injected
+  sharding leak (``all_gather`` inside the ring body), R1 a doctored
+  module whose permute depends on the compute, R3 synthetic downcast /
+  bf16-dot modules;
+- the CLI contract: ``mpi-knn lint`` writes the JSON report and its exit
+  status IS the verdict.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpi_knn_tpu.analysis import engine, lowering
+from mpi_knn_tpu.analysis import rules as rules_mod
+from mpi_knn_tpu.config import KNNConfig
+
+
+def _ctx(backend="serial", metric="l2", dtype="float32", **meta):
+    meta.setdefault("q_tile", 8)
+    meta.setdefault("c_tile", 16)
+    meta.setdefault("acc_bytes", 8 if dtype == "float64" else 4)
+    return engine.LintContext(
+        target=lowering.LintTarget(backend, metric, dtype),
+        cfg=KNNConfig(k=4, metric=metric, query_tile=8, corpus_tile=16),
+        meta=meta,
+    )
+
+
+def _rules(*names):
+    return [r for r in rules_mod.RULES if r.name in names]
+
+
+# ---------------------------------------------------------------------------
+# the full matrix, parametrized per cell
+
+
+@pytest.mark.parametrize(
+    "target", lowering.default_targets(), ids=lambda t: t.label
+)
+def test_full_matrix_is_clean(target):
+    res = engine.lint_target(target)
+    if res.skipped is not None:
+        # the only registered restriction: pallas computes in f32
+        assert target.backend == "pallas" and target.dtype != "float32", (
+            target.label,
+            res.skipped,
+        )
+        return
+    assert res.ok, "\n".join(
+        f"[{f.rule}] {f.stage}: {f.message}" for f in res.findings
+    )
+    assert set(res.stages) == {"before_opt", "after_opt"}
+    ran = set(res.rules_run)
+    assert {"R2-memory", "R3-dtype", "R4-collective"} <= ran
+    if target.backend in ("ring", "ring-overlap"):
+        assert "R1-overlap" in ran
+    else:
+        assert "R1-overlap" not in ran
+
+
+# ---------------------------------------------------------------------------
+# R2: a deliberately de-tiled lowering must be caught
+
+
+def test_r2_catches_detiled_distance_matrix():
+    """Compute the FULL (nq × m) distance matrix in one shot — the exact
+    mistake tiling exists to prevent (an HBM-busting materialization at
+    SIFT scale) — and assert the memory rule flags it in both stages."""
+    from mpi_knn_tpu.ops.distance import pairwise_sq_l2
+
+    def detiled(q, c):
+        d = pairwise_sq_l2(q, c)  # (64, 4096) in one buffer
+        return jax.lax.top_k(-d, 4)
+
+    lowered = jax.jit(detiled).lower(
+        jnp.zeros((64, 32), jnp.float32), jnp.zeros((4096, 32), jnp.float32)
+    )
+    texts = lowering.hlo_texts(lowered)
+    findings, ran = engine.run_rules(texts, _ctx(), _rules("R2-memory"))
+    assert ran == ["R2-memory"]
+    assert findings, "de-tiled lowering passed the memory bound"
+    assert {f.stage for f in findings} == {"before_opt", "after_opt"}
+    # the flagged buffer really is matrix-sized, not some small temp
+    assert max(f.details["bytes"] for f in findings) >= 64 * 4096 * 4
+
+
+def test_r2_passes_the_tiled_equivalent():
+    """Same computation, production tiling — the serial matrix cell —
+    stays under the budget (the rule separates shapes, not programs)."""
+    res = engine.lint_target(lowering.LintTarget("serial", "l2", "float32"))
+    assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# R4: an injected sharding leak must be caught
+
+
+def test_r4_catches_injected_sharding_leak():
+    """A ring body that all-gathers the corpus instead of rotating it —
+    the classic sharding leak: results stay correct, memory and bytes on
+    the wire silently stop scaling with the ring."""
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+    from mpi_knn_tpu.utils.compat import shard_map
+
+    mesh = make_ring_mesh(None)
+    axis = mesh.axis_names[0]
+
+    def leaky(blk):
+        return jax.lax.all_gather(blk, axis, axis=0, tiled=True)
+
+    fn = jax.jit(
+        shard_map(leaky, mesh=mesh, in_specs=P(axis), out_specs=P())
+    )
+    texts = lowering.hlo_texts(
+        fn.lower(jnp.zeros((128, 32), jnp.float32))
+    )
+    ctx = _ctx(backend="ring", ring_n=8, expected_permutes=2)
+    findings, _ = engine.run_rules(texts, ctx, _rules("R4-collective"))
+    strays = [f for f in findings if f.details.get("op") == "all-gather"]
+    assert strays, "all-gather leak not flagged"
+
+
+def test_r4_flags_any_collective_in_single_device_backends():
+    """The same leaked program judged as a serial lowering: ANY collective
+    is a violation there."""
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+    from mpi_knn_tpu.utils.compat import shard_map
+
+    mesh = make_ring_mesh(None)
+    axis = mesh.axis_names[0]
+
+    def leaky(blk):
+        return jax.lax.all_gather(blk, axis, axis=0, tiled=True)
+
+    fn = jax.jit(
+        shard_map(leaky, mesh=mesh, in_specs=P(axis), out_specs=P())
+    )
+    texts = lowering.hlo_texts(
+        fn.lower(jnp.zeros((128, 32), jnp.float32))
+    )
+    findings, _ = engine.run_rules(texts, _ctx(), _rules("R4-collective"))
+    assert any("sharding leak" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# R1: the overlap/sequencing rule through the engine path
+
+_SEQUENCED = """\
+HloModule m, entry_computation_layout={(f32[4,8]{1,0})->f32[4,4]{1,0}}
+
+%inner.1 (p.1: f32[4,8], p.2: f32[4,8]) -> f32[4,4] {
+  %p.1 = f32[4,8]{1,0} parameter(0)
+  %p.2 = f32[4,8]{1,0} parameter(1)
+  ROOT %d.1 = f32[4,4]{1,0} dot(%p.1, %p.2), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+
+ENTRY %main.2 (a.1: f32[4,8]) -> f32[4,4] {
+  %a.1 = f32[4,8]{1,0} parameter(0)
+  %c.1 = f32[4,4]{1,0} call(%a.1, %a.1), to_apply=%inner.1
+  %t.1 = (f32[4,4]{1,0}, f32[4,8]{1,0}) tuple(%c.1, %a.1)
+  %b.1 = (f32[4,4]{1,0}, f32[4,8]{1,0}) opt-barrier(%t.1)
+  %g.1 = f32[4,8]{1,0} get-tuple-element(%b.1), index=1
+  %cp.1 = f32[4,8]{1,0} collective-permute(%g.1), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  ROOT %r.1 = f32[4,4]{1,0} get-tuple-element(%b.1), index=0
+}
+"""
+
+
+def test_r1_flags_a_sequenced_permute_in_the_overlap_schedule():
+    """A permute reading through the barrier (the blocking shape) labeled
+    as the OVERLAP schedule must fail R1 in both stages — this is exactly
+    the reference's bug class: overlap requested, overlap not achieved."""
+    texts = {"before_opt": _SEQUENCED, "after_opt": _SEQUENCED}
+    ctx = _ctx(backend="ring-overlap", ring_n=2, expected_permutes=1)
+    findings, _ = engine.run_rules(texts, ctx, _rules("R1-overlap"))
+    assert len(findings) >= 2  # compute dependence + barrier, both stages
+    assert all(f.rule == "R1-overlap" for f in findings)
+    # and the SAME module labeled blocking passes (before-opt claim)
+    ctx2 = _ctx(backend="ring", ring_n=2, expected_permutes=1)
+    findings2, _ = engine.run_rules(
+        {"before_opt": _SEQUENCED}, ctx2, _rules("R1-overlap")
+    )
+    assert not findings2
+
+
+# ---------------------------------------------------------------------------
+# R3: dtype integrity on synthetic counterexamples
+
+
+def test_r3_flags_silent_f64_downcast():
+    mod = """\
+HloModule m, entry_computation_layout={(f64[4,8]{1,0})->f32[4,8]{1,0}}
+
+ENTRY %main.1 (a.1: f64[4,8]) -> f32[4,8] {
+  %a.1 = f64[4,8]{1,0} parameter(0)
+  ROOT %c.1 = f32[4,8]{1,0} convert(%a.1)
+}
+"""
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _ctx(dtype="float64"), _rules("R3-dtype")
+    )
+    assert findings and "f64" in findings[0].message
+    # the same convert under a float32 config is nobody's business
+    findings2, _ = engine.run_rules(
+        {"before_opt": mod}, _ctx(dtype="float32"), _rules("R3-dtype")
+    )
+    assert not findings2
+
+
+def test_r3_flags_bf16_dot_without_f32_accumulation():
+    mod = """\
+HloModule m, entry_computation_layout={(bf16[4,8]{1,0})->bf16[4,4]{1,0}}
+
+ENTRY %main.1 (a.1: bf16[4,8]) -> bf16[4,4] {
+  %a.1 = bf16[4,8]{1,0} parameter(0)
+  ROOT %d.1 = bf16[4,4]{1,0} dot(%a.1, %a.1), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+"""
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _ctx(dtype="bfloat16"), _rules("R3-dtype")
+    )
+    assert findings and "bf16 dot" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# report + CLI contract
+
+
+def test_report_json_schema(tmp_path):
+    report = engine.run_matrix(
+        [lowering.LintTarget("serial", "l2", "float32")]
+    )
+    path = report.save(tmp_path)
+    data = json.loads(path.read_text())
+    assert data["ok"] is True
+    assert data["schema_version"] == engine.SCHEMA_VERSION
+    assert data["summary"]["targets_checked"] == 1
+    (entry,) = data["targets"]
+    assert entry["backend"] == "serial" and entry["ok"] is True
+    assert entry["stages"] == ["before_opt", "after_opt"]
+
+
+def test_cli_lint_exit_codes(tmp_path):
+    from mpi_knn_tpu.analysis import cli as lint_cli
+
+    rc = lint_cli.main(
+        ["--backend", "serial", "--metric", "l2", "--dtype", "float32",
+         "--out", str(tmp_path), "-q"]
+    )
+    assert rc == 0
+    assert (tmp_path / "report.json").exists()
+
+    # exit is non-zero when any rule reports: inject an always-failing
+    # rule into the registry for the duration
+    class _AlwaysFails(rules_mod.Rule):
+        name = "R0-test-canary"
+        description = "always fails (test injection)"
+
+        def check(self, ctx, stage, module):
+            return [
+                rules_mod.Finding(
+                    self.name, ctx.target.label, stage, "canary"
+                )
+            ]
+
+    rules_mod.RULES.append(_AlwaysFails())
+    try:
+        rc = lint_cli.main(
+            ["--backend", "serial", "--metric", "l2", "--dtype", "float32",
+             "--rule", "R0-test-canary", "--out", str(tmp_path), "-q"]
+        )
+    finally:
+        rules_mod.RULES.pop()
+    assert rc == 1
+    data = json.loads((tmp_path / "report.json").read_text())
+    assert data["ok"] is False
+
+
+def test_cli_lint_unknown_rule_is_usage_error(tmp_path):
+    from mpi_knn_tpu.analysis import cli as lint_cli
+
+    rc = lint_cli.main(
+        ["--backend", "serial", "--rule", "R9-no-such", "--out",
+         str(tmp_path), "-q"]
+    )
+    assert rc == 2

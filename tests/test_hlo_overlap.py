@@ -24,13 +24,11 @@ import pathlib
 import subprocess
 import sys
 
-import pytest
-
-from mpi_knn_tpu.utils.hlo_graph import (
-    parse_hlo,
+from mpi_knn_tpu.analysis.rules import (
     permute_dependence_report,
     property_holds,
 )
+from mpi_knn_tpu.utils.hlo_graph import parse_hlo
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 ART = REPO / "artifacts" / "hlo"

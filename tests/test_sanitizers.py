@@ -8,7 +8,6 @@ every backend to the serial ground truth.
 
 from pathlib import Path
 
-import jax
 import numpy as np
 import pytest
 
@@ -21,23 +20,21 @@ def _data(rng, m=64, d=12):
     return rng.standard_normal((m, d)).astype(np.float32)
 
 
-def test_no_nans_under_debug_nans(rng):
+def test_no_nans_under_debug_nans(rng, debug_nans):
     """The full pipeline (distances -> masks -> top-k -> vote) must not
     produce NaNs even with duplicate rows and zero vectors in the corpus.
-    +inf sentinels are fine; NaN would poison comparisons silently."""
+    +inf sentinels are fine; NaN would poison comparisons silently.
+    The flag toggle lives in the ``debug_nans`` conftest fixture so a
+    mid-test crash can never leak it into later tests."""
     X = _data(rng)
     X[10] = X[3]  # exact duplicate (zero-distance path)
     X[20] = 0.0  # zero vector (cosine normalization edge)
     y = rng.integers(0, 4, size=len(X)).astype(np.int32)
-    jax.config.update("jax_debug_nans", True)
-    try:
-        for metric in ("l2", "cosine"):
-            res = all_knn(X, config=KNNConfig(k=5, metric=metric,
-                                              query_tile=16, corpus_tile=32))
-            cls = knn_classify(res, y, num_classes=4)
-            np.asarray(cls.predictions)
-    finally:
-        jax.config.update("jax_debug_nans", False)
+    for metric in ("l2", "cosine"):
+        res = all_knn(X, config=KNNConfig(k=5, metric=metric,
+                                          query_tile=16, corpus_tile=32))
+        cls = knn_classify(res, y, num_classes=4)
+        np.asarray(cls.predictions)
 
 
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float64"])
@@ -65,13 +62,10 @@ def test_dtype_sweep_recall(rng, dtype, backend):
     assert rec >= (0.97 if dtype == "bfloat16" else 0.999), rec
 
 
-def _asan_runtime_or_skip(so_name: str):
+def _build_sanitizer_lib_or_skip(so_name: str):
     """Build ONE sanitizer lib (per-artifact, mirroring data/_native.py:
-    a failure in another library's rule must not block this one) and locate
-    the matching ASan runtime, or skip. The runtime must come from the SAME
-    compiler family the Makefile used ($(CXX)); a gcc-located libasan under
-    a clang-built .so aborts at interceptor init."""
-    import os
+    a failure in another library's rule must not block this one), or skip
+    when the toolchain is absent. Shared by the ASan and UBSan tests."""
     import subprocess
 
     mk = subprocess.run(
@@ -79,7 +73,19 @@ def _asan_runtime_or_skip(so_name: str):
         capture_output=True, text=True, cwd=_REPO, timeout=120,
     )
     if mk.returncode != 0:
-        pytest.skip(f"no ASan toolchain: {mk.stderr[-200:]}")
+        pytest.skip(f"no sanitizer toolchain: {mk.stderr[-200:]}")
+    return _REPO / "native" / "build" / so_name
+
+
+def _asan_runtime_or_skip(so_name: str):
+    """Build + locate the matching ASan runtime, or skip. The runtime must
+    come from the SAME compiler family the Makefile used ($(CXX)); a
+    gcc-located libasan under a clang-built .so aborts at interceptor
+    init."""
+    import os
+    import subprocess
+
+    _build_sanitizer_lib_or_skip(so_name)
     cxx = os.environ.get("CXX", "g++")
     if "clang" in cxx:
         locator = [cxx, "-print-file-name=libclang_rt.asan-x86_64.so"]
@@ -100,28 +106,21 @@ def _asan_runtime_or_skip(so_name: str):
     return libasan
 
 
-def _run_under_asan(code: str, libasan: str):
+def _run_sanitized(code: str, **env_extra):
     import os
     import subprocess
     import sys
 
     return subprocess.run(
         [sys.executable, "-c", code],
-        env=dict(os.environ, LD_PRELOAD=libasan,
-                 ASAN_OPTIONS="detect_leaks=0"),
+        env=dict(os.environ, **env_extra),
         capture_output=True, text=True, cwd=_REPO, timeout=300,
     )
 
 
-def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
-    """The C++ MAT parser, built with AddressSanitizer, sweeps every genuine
-    MATLAB-written fixture scipy ships (110 files: v5 it parses, v4/
-    big-endian/object files it must reject) with zero sanitizer aborts —
-    the native-code analog of the Q2 race-tooling the reference lacked.
-    Subprocess: ASan must be LD_PRELOADed before the interpreter starts."""
+def _scipy_mat_dir_or_skip():
     import os
 
-    libasan = _asan_runtime_or_skip("libtknn_matio_asan.so")
     data_dir = None
     try:
         import scipy.io as sio
@@ -132,37 +131,71 @@ def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
         pass
     if not data_dir or not os.path.isdir(data_dir):
         pytest.skip("scipy matlab fixtures unavailable")
-    code = f"""
+    return data_dir
+
+
+def _mat_sweep_code(lib_path, data_dir) -> str:
+    """The genuine-MATLAB-fixture sweep (110 files: v5 parsed, v4/
+    big-endian/object rejected) over the PRODUCTION read loop, against a
+    sanitizer-built lib. Shared by the ASan and UBSan tests."""
+    return f"""
 import ctypes, glob
 from mpi_knn_tpu.data.matfile import read_mat_native
-lib = ctypes.CDLL({str(_REPO / 'native/build/libtknn_matio_asan.so')!r})
+lib = ctypes.CDLL({str(lib_path)!r})
 n_ok = n_err = 0
 for f in sorted(glob.glob({data_dir!r} + '/*.mat')):
     try:
-        read_mat_native(f, lib=lib)  # the PRODUCTION read loop, under ASan
+        read_mat_native(f, lib=lib)  # the PRODUCTION read loop
         n_ok += 1
     except ValueError:
         n_err += 1
 print('PARSED', n_ok, 'REJECTED', n_err)
 assert n_ok >= 70 and n_err >= 25
 """
-    r = _run_under_asan(code, libasan)
+
+
+def test_native_mat_reader_asan_clean_on_genuine_matlab_files():
+    """The C++ MAT parser, built with AddressSanitizer, sweeps every genuine
+    MATLAB-written fixture scipy ships with zero sanitizer aborts — the
+    native-code analog of the Q2 race-tooling the reference lacked.
+    Subprocess: ASan must be LD_PRELOADed before the interpreter starts."""
+    libasan = _asan_runtime_or_skip("libtknn_matio_asan.so")
+    data_dir = _scipy_mat_dir_or_skip()
+    code = _mat_sweep_code(
+        _REPO / "native/build/libtknn_matio_asan.so", data_dir
+    )
+    r = _run_sanitized(code, LD_PRELOAD=libasan,
+                       ASAN_OPTIONS="detect_leaks=0")
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "PARSED" in r.stdout
 
 
-def test_native_vecs_reader_asan_clean():
-    """Same sweep for the fvecs/bvecs/ivecs reader: valid files plus
-    truncated/absurd-dim/inconsistent mutants, the PRODUCTION read loop
-    under ASan."""
-    libasan = _asan_runtime_or_skip("libtknn_vecsio_asan.so")
-    vecs_code = f"""
+def test_native_mat_reader_ubsan_clean_on_genuine_matlab_files():
+    """Same sweep against the UBSan build: signed overflow, misaligned or
+    out-of-range loads in the tag/dimension arithmetic abort the
+    subprocess (-fno-sanitize-recover). No preload needed — libubsan is a
+    NEEDED dep of the .so."""
+    lib = _build_sanitizer_lib_or_skip("libtknn_matio_ubsan.so")
+    data_dir = _scipy_mat_dir_or_skip()
+    r = _run_sanitized(
+        _mat_sweep_code(lib, data_dir),
+        UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1",
+    )
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "PARSED" in r.stdout
+
+
+def _vecs_sweep_code(lib_path) -> str:
+    """fvecs/bvecs/ivecs sweep: valid files plus truncated/absurd-dim/
+    inconsistent mutants through the PRODUCTION read loop. Shared by the
+    ASan and UBSan tests."""
+    return f"""
 import ctypes, struct
 import numpy as np
 from pathlib import Path
 import tempfile
 from mpi_knn_tpu.data.vecs import read_vecs_native
-lib = ctypes.CDLL({str(_REPO / 'native/build/libtknn_vecsio_asan.so')!r})
+lib = ctypes.CDLL({str(lib_path)!r})
 with tempfile.TemporaryDirectory() as td:
     tmp = Path(td)
     rng = np.random.default_rng(0)
@@ -193,7 +226,26 @@ with tempfile.TemporaryDirectory() as td:
     print('VECS_OK', ok, 'VECS_REJECTED', rejected)
     assert ok == 3 and rejected == 3
 """
-    r = _run_under_asan(vecs_code, libasan)
+
+
+def test_native_vecs_reader_asan_clean():
+    libasan = _asan_runtime_or_skip("libtknn_vecsio_asan.so")
+    code = _vecs_sweep_code(_REPO / "native/build/libtknn_vecsio_asan.so")
+    r = _run_sanitized(code, LD_PRELOAD=libasan,
+                       ASAN_OPTIONS="detect_leaks=0")
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "VECS_OK 3" in r.stdout
+
+
+def test_native_vecs_reader_ubsan_clean():
+    """The mutant sweep is where UB hides in a reader: a 1<<30 dim header
+    multiplied into a byte count is exactly the signed-overflow class
+    UBSan exists for."""
+    lib = _build_sanitizer_lib_or_skip("libtknn_vecsio_ubsan.so")
+    r = _run_sanitized(
+        _vecs_sweep_code(lib),
+        UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1",
+    )
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "VECS_OK 3" in r.stdout
 
