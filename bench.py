@@ -24,6 +24,9 @@ the north star at equal silicon.
 
 Environment knobs: BENCH_M (default 60000), BENCH_BACKEND (serial|pallas),
 BENCH_REPS, BENCH_QT/BENCH_CT (tiles), BENCH_TOPK (exact|approx),
+BENCH_PRECISION (default|high|highest), BENCH_PRECISION_POLICY
+(exact|mixed — mixed is the compress-and-rerank pipeline and owns both dot
+precisions, so it overrides BENCH_PRECISION),
 BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_WATCHDOG_S (0 disables),
 BENCH_PLATFORM (forces jax_platforms via the config API — JAX_PLATFORMS
 alone is ignored by the axon TPU plugin), TKNN_MNIST (real data path;
@@ -93,6 +96,24 @@ def main() -> int:
               file=sys.stderr)
         return 2
     backend = os.environ.get("BENCH_BACKEND", "serial")
+    # BENCH_PRECISION_POLICY=mixed: the compress-and-rerank pipeline — the
+    # O(q·c·d) dot runs single-pass bf16 MXU, only the 4k-overfetched
+    # survivors are reranked at HIGHEST. The policy owns both dot
+    # precisions, so combining it with an explicit BENCH_PRECISION is a
+    # usage error — refuse loudly rather than silently ignore one knob
+    # (an A/B sweep over BENCH_PRECISION would otherwise record identical
+    # mixed runs mislabeled as precision variants).
+    precision_policy = os.environ.get("BENCH_PRECISION_POLICY", "exact")
+    if precision_policy == "mixed" and os.environ.get("BENCH_PRECISION"):
+        print(
+            json.dumps({
+                "error": "BENCH_PRECISION conflicts with "
+                "BENCH_PRECISION_POLICY=mixed (the policy owns both dot "
+                "precisions: DEFAULT compress, HIGHEST rerank)"
+            }),
+            file=sys.stderr,
+        )
+        return 2
     # BENCH_CENTER=0: skip mean-centering — read ONCE; the zero_eps pairing
     # below derives from the same bool so the two can never desync
     center = os.environ.get("BENCH_CENTER", "1") != "0"
@@ -122,8 +143,15 @@ def main() -> int:
         # bench default HIGH (3-pass bf16): measured recall 1.0 on the
         # integer-pixel corpus with ~4% median win over HIGHEST (r3 A/B,
         # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
-        # its data; the library does not. BENCH_PRECISION overrides.
-        matmul_precision=os.environ.get("BENCH_PRECISION") or "high",
+        # its data; the library does not. BENCH_PRECISION overrides;
+        # BENCH_PRECISION_POLICY=mixed takes the knob over entirely (the
+        # conflicting combination was rejected above).
+        matmul_precision=(
+            None
+            if precision_policy == "mixed"
+            else os.environ.get("BENCH_PRECISION") or "high"
+        ),
+        precision_policy=precision_policy,
         # BENCH_RING_XFER=bfloat16 halves ICI bytes per ring hop (the knob
         # only matters for BENCH_BACKEND=ring/ring-overlap)
         ring_transfer_dtype=os.environ.get("BENCH_RING_XFER") or None,
@@ -189,6 +217,7 @@ def main() -> int:
                 "target_seconds_at_this_chip_count": target_here,
                 "recall_gate": RECALL_GATE,
                 "topk_method": cfg.topk_method,
+                "precision_policy": cfg.precision_policy,
                 "merge_schedule": cfg.merge_schedule,
                 "tiles": [cfg.query_tile, cfg.corpus_tile],
             }

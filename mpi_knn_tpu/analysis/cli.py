@@ -28,6 +28,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restrict to metric(s); repeatable")
     p.add_argument("--dtype", action="append", choices=LINT_DTYPES,
                    help="restrict to dtype(s); repeatable")
+    p.add_argument("--policy", action="append", choices=["exact", "mixed"],
+                   help="restrict to precision policy(ies): exact "
+                   "(one-pass HIGHEST distances) or mixed (the compress-"
+                   "and-rerank pipeline, whose dot-precision contract R3 "
+                   "certifies); repeatable")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -73,6 +78,7 @@ def main(argv=None) -> int:
         if (not args.backend or t.backend in args.backend)
         and (not args.metric or t.metric in args.metric)
         and (not args.dtype or t.dtype in args.dtype)
+        and (not args.policy or t.policy in args.policy)
     ]
     if not targets:
         print("error: no targets match the given filters", file=sys.stderr)

@@ -57,6 +57,7 @@ class TargetResult:
             "backend": self.target.backend,
             "metric": self.target.metric,
             "dtype": self.target.dtype,
+            "policy": self.target.policy,
             "ok": self.ok,
             "skipped": self.skipped,
             "rules_run": self.rules_run,

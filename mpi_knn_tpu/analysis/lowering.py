@@ -31,6 +31,7 @@ from mpi_knn_tpu.config import BACKENDS, METRICS, KNNConfig
 
 STAGES = ("before_opt", "after_opt")
 LINT_DTYPES = ("float32", "bfloat16", "float64")
+LINT_POLICIES = ("exact", "mixed")
 LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
 
 # Small but structurally faithful: 8 query tiles, 8 corpus tiles, an 8-way
@@ -38,19 +39,26 @@ LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
 # loop the production shapes have, at compile-in-seconds size.
 LINT_M, LINT_NQ, LINT_D, LINT_K = 128, 64, 32, 4
 LINT_QUERY_TILE, LINT_CORPUS_TILE = 8, 16
+# Mixed-policy cells need tiles WIDER than the 4k overfetch, or the two-pass
+# pipeline would degenerate to the exact fallback and R3's compress/rerank
+# dot contract would be vacuously unverifiable: a 2× corpus and a 32-wide
+# tile keep 4k=16 < c_tile=32 even on the 8-way ring (256/8 = 32 per block).
+LINT_M_MIXED, LINT_CORPUS_TILE_MIXED = 256, 32
 
 
 @dataclasses.dataclass(frozen=True)
 class LintTarget:
-    """One cell of the backend × metric × dtype matrix."""
+    """One cell of the backend × metric × dtype × precision-policy matrix."""
 
     backend: str
     metric: str
     dtype: str
+    policy: str = "exact"
 
     @property
     def label(self) -> str:
-        return f"{self.backend}/{self.metric}/{self.dtype}"
+        base = f"{self.backend}/{self.metric}/{self.dtype}"
+        return base if self.policy == "exact" else f"{base}/{self.policy}"
 
 
 def default_targets() -> list[LintTarget]:
@@ -59,6 +67,12 @@ def default_targets() -> list[LintTarget]:
         for b in LINT_BACKENDS
         for m in METRICS
         for d in LINT_DTYPES
+    ] + [
+        # the mixed compress-and-rerank policy: float32 only (config.py
+        # validation), every backend × metric
+        LintTarget(b, m, "float32", "mixed")
+        for b in LINT_BACKENDS
+        for m in METRICS
     ]
 
 
@@ -68,13 +82,32 @@ class UnsupportedTarget(Exception):
 
 
 def _base_cfg(target: LintTarget) -> KNNConfig:
+    mixed = target.policy == "mixed"
     return KNNConfig(
         k=LINT_K,
         metric=target.metric,
         dtype=target.dtype,
         query_tile=LINT_QUERY_TILE,
-        corpus_tile=LINT_CORPUS_TILE,
+        corpus_tile=(
+            LINT_CORPUS_TILE_MIXED if mixed else LINT_CORPUS_TILE
+        ),
+        precision_policy=target.policy,
     )
+
+
+def _lint_m(target: LintTarget) -> int:
+    return LINT_M_MIXED if target.policy == "mixed" else LINT_M
+
+
+def _mixed_meta(target: LintTarget, q_tile: int, c_tile: int):
+    """R2 budget extension for mixed cells: the rerank legitimately gathers
+    a (q_tile, 4k, d) block of survivor rows — account for it explicitly
+    instead of riding on the input-size floor."""
+    if target.policy != "mixed":
+        return {}
+    from mpi_knn_tpu.ops.rerank import overfetch_width
+
+    return {"extra_elems": q_tile * overfetch_width(LINT_K, c_tile) * LINT_D}
 
 
 def _acc_bytes(dtype: str) -> int:
@@ -109,9 +142,10 @@ def _lower_serial(target: LintTarget):
 
     _require_x64(target)
     cfg = _base_cfg(target)
-    q_tile, c_tile = effective_tiles(cfg, LINT_M, LINT_NQ)
+    m = _lint_m(target)
+    q_tile, c_tile = effective_tiles(cfg, m, LINT_NQ)
     q_tiles, qid_tiles, c_tiles, c_tile_ids, q_pad = prepare_tiles(
-        np.zeros((LINT_M, LINT_D), np.float32),
+        np.zeros((m, LINT_D), np.float32),
         np.zeros((LINT_NQ, LINT_D), np.float32),
         np.full(LINT_NQ, -1, np.int32),
         cfg,
@@ -131,7 +165,8 @@ def _lower_serial(target: LintTarget):
         cfg,
     )
     meta = {"q_tile": q_tile, "c_tile": c_tile,
-            "acc_bytes": _acc_bytes(target.dtype)}
+            "acc_bytes": _acc_bytes(target.dtype),
+            **_mixed_meta(target, q_tile, c_tile)}
     return lowered, cfg, meta
 
 
@@ -150,9 +185,10 @@ def _lower_ring(target: LintTarget):
             "with virtual devices first, as the lint CLI does)"
         )
     cfg = _base_cfg(target)
+    m = _lint_m(target)
     mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
     q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
-    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, LINT_M, LINT_NQ, dp, ring_n)
+    q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, LINT_NQ, dp, ring_n)
     dtype = jnp.dtype(cfg.dtype)
     lowered = _ring_knn_sharded.lower(
         jnp.zeros((q_pad, LINT_D), dtype),
@@ -174,6 +210,7 @@ def _lower_ring(target: LintTarget):
         "ring_n": ring_n,
         # the corpus block and its global-id row rotate together
         "expected_permutes": 2,
+        **_mixed_meta(target, q_tile, c_tile),
     }
     return lowered, cfg, meta
 
@@ -190,14 +227,15 @@ def _lower_pallas(target: LintTarget):
             "rejects other dtypes)"
         )
     cfg = _base_cfg(target)
+    m = _lint_m(target)
     # same tile policy as all_knn_pallas (MXU/VPU alignment + caps); cosine
     # rides the L2 kernels on pre-normalized rows, so the lowered program
     # is the L2 kernel either way and the metric needs no special casing
     q_tile = min(max(8, pad_to_multiple(cfg.query_tile, 8)), 512,
                  pad_to_multiple(LINT_NQ, 8))
     c_tile = min(max(128, pad_to_multiple(cfg.corpus_tile, 128)), 2048,
-                 pad_to_multiple(LINT_M, 128))
-    c_pad = pad_to_multiple(LINT_M, c_tile)
+                 pad_to_multiple(m, 128))
+    c_pad = pad_to_multiple(m, c_tile)
     q_pad = pad_to_multiple(LINT_NQ, q_tile)
     lowered = _pallas_all_knn.lower(
         jnp.zeros((q_pad, LINT_D), jnp.float32),
@@ -205,11 +243,15 @@ def _lower_pallas(target: LintTarget):
         cfg,
         q_tile,
         c_tile,
-        LINT_M,
+        m,
         False,
         cfg.pallas_variant,
     )
-    meta = {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4}
+    # fused-path rerank width is the global overfetch: the per-tile
+    # survivor lists are preselected back down to 4k on compressed keys
+    # before the gather (backends/pallas_backend.py)
+    meta = {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4,
+            **_mixed_meta(target, q_tile, c_tile)}
     return lowered, cfg, meta
 
 

@@ -316,8 +316,15 @@ class R2Memory(Rule):
         acc_bytes = ctx.meta["acc_bytes"]
         # element-denominated, then priced at the accumulation width: an
         # input-sized buffer may widen to the accumulator dtype (bf16
-        # corpus → f32 norms path) but must not GROW in element count
-        budget = max(max_param, R2_SLACK * tile_elems) * acc_bytes
+        # corpus → f32 norms path) but must not GROW in element count.
+        # "extra_elems" is the lowering's registered legitimate intermediate
+        # beyond the tile (today: the mixed policy's (q_tile, 4k, d) rerank
+        # gather) — declared per configuration, never a blanket slack bump.
+        budget = max(
+            max_param,
+            R2_SLACK * tile_elems,
+            ctx.meta.get("extra_elems", 0),
+        ) * acc_bytes
         out = []
         for c in module.computations.values():
             for i in c.instructions.values():
@@ -355,12 +362,30 @@ def _result_dtype(type_str: str) -> str | None:
     return m.group(1) if m else None
 
 
+_PRECISION_RE = re.compile(r"operand_precision=\{([^}]*)\}")
+
+
+def dot_precision_class(instr) -> str:
+    """Canonical precision of a ``dot``: the ``operand_precision`` attr
+    ("highest"/"high"), or "default" when absent (XLA prints nothing for
+    DEFAULT). Mismatched per-operand settings are reported joined — the
+    mixed contract treats anything but a uniform default/highest as a
+    violation."""
+    m = _PRECISION_RE.search(instr.attrs)
+    if not m:
+        return "default"
+    vals = {v.strip() for v in m.group(1).split(",") if v.strip()}
+    return vals.pop() if len(vals) == 1 else "/".join(sorted(vals))
+
+
 @register
 class R3Dtype(Rule):
     name = "R3-dtype"
     description = (
         "no silent f64 downcast in float64 debug mode; bf16 dots must "
-        "accumulate in f32 or wider"
+        "accumulate in f32 or wider; mixed-policy programs declare exactly "
+        "one DEFAULT compress dot per tile computation with the rerank dot "
+        "at HIGHEST"
     )
 
     def applies(self, ctx) -> bool:
@@ -407,6 +432,88 @@ class R3Dtype(Rule):
                                 {"type": i.type_str},
                             )
                         )
+        if (
+            stage == "before_opt"
+            and getattr(ctx.cfg, "precision_policy", "exact") == "mixed"
+        ):
+            out.extend(self._check_mixed_contract(ctx, stage, module))
+        return out
+
+    def _check_mixed_contract(self, ctx, stage, module) -> list[Finding]:
+        """The DECLARED mixed-precision contract, machine-checked on the
+        module XLA receives (before-opt: optimization may legally fuse or
+        rewrite dots afterwards, but the declared precisions are fixed
+        here): every dot is either the compress (DEFAULT — single-pass
+        bf16 MXU) or the rerank (HIGHEST — multi-pass exact); each tile
+        computation contains at most ONE compress dot; and both passes
+        must actually exist — a mixed program with no DEFAULT dot never
+        compressed (it silently pays exact FLOPs), one with no HIGHEST
+        dot never reranks (it silently ships compressed distances)."""
+        out = []
+        n_default = n_highest = 0
+        for c in module.computations.values():
+            defaults_here = []
+            for i in c.instructions.values():
+                if i.opcode != "dot":
+                    continue
+                cls = dot_precision_class(i)
+                if cls == "default":
+                    defaults_here.append(i.name)
+                    n_default += 1
+                elif cls == "highest":
+                    n_highest += 1
+                else:
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} is a dot at precision "
+                            f"{cls!r} — the mixed contract allows only the "
+                            "DEFAULT compress dot and the HIGHEST rerank "
+                            "dot",
+                            {"precision": cls, "type": i.type_str},
+                        )
+                    )
+            if len(defaults_here) > 1:
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.target.label,
+                        stage,
+                        f"{c.name} contains {len(defaults_here)} "
+                        "DEFAULT-precision dots "
+                        f"({', '.join(defaults_here)}) — the compress pass "
+                        "is exactly one single-pass dot per tile; a second "
+                        "one is a silent downcast of work the contract "
+                        "promises at HIGHEST",
+                        {"dots": defaults_here},
+                    )
+                )
+        if n_default == 0:
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.target.label,
+                    stage,
+                    "mixed policy lowered NO DEFAULT-precision compress "
+                    "dot — the program pays exact multi-pass FLOPs on the "
+                    "full tile (the policy silently degenerated to exact)",
+                    {},
+                )
+            )
+        if n_highest == 0:
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.target.label,
+                    stage,
+                    "mixed policy lowered NO HIGHEST-precision rerank dot "
+                    "— compressed distances would reach the final top-k "
+                    "unreranked",
+                    {},
+                )
+            )
         return out
 
 

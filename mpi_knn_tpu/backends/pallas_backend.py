@@ -32,12 +32,64 @@ import numpy as np
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import _NORM_EPS, _l2_normalize, sq_norms
 from mpi_knn_tpu.ops.pallas_knn import _ZERO_RTOL, fused_knn_sweep, fused_knn_tiles
+from mpi_knn_tpu.ops.rerank import (
+    mixed_applies,
+    overfetch_width,
+    rerank_exact_topk,
+)
 from mpi_knn_tpu.ops.topk import smallest_k
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
     pad_rows_any,
     pad_to_multiple,
 )
+
+
+def _mixed_exact_finish(queries, corpus, cand_i, cfg, q_tile, all_pairs):
+    """Pass-2 of the mixed policy for the fused path: the kernel's
+    overfetched candidates (compressed-key survivors, global ids) are
+    reranked exactly in XLA — gather the survivors' corpus rows, recompute
+    at HIGHEST, re-apply the mask semantics on exact values, final top-k.
+    Runs per query tile under ``lax.map`` so the (q_tile, V, d) gather —
+    not a (Q, V, d) one — is the peak intermediate. Cosine rides through
+    as L2 on the pre-normalized rows, same as the kernel itself."""
+    Q = queries.shape[0]
+    csq = sq_norms(corpus)  # exact norms, hoisted out of the tile map
+    q_ids = (
+        jnp.arange(Q, dtype=jnp.int32)
+        if all_pairs
+        else jnp.full(Q, -1, jnp.int32)
+    )
+    qt = Q // q_tile
+    V = cand_i.shape[1]
+
+    def per_tile(args):
+        q_x, q_id, ci = args
+        idx = jnp.maximum(ci, 0)  # INVALID_ID slots: clamp, re-mask below
+        rows = jnp.take(corpus, idx, axis=0)  # (q_tile, V, d)
+        return rerank_exact_topk(
+            q_x,
+            q_id,
+            sq_norms(q_x),
+            rows,
+            ci,
+            jnp.take(csq, idx, axis=0),
+            cfg.k,
+            metric="l2",
+            exclude_self=cfg.exclude_self and all_pairs,
+            exclude_zero=cfg.exclude_zero,
+            zero_eps=cfg.zero_eps,
+        )
+
+    d, i = jax.lax.map(
+        per_tile,
+        (
+            queries.reshape(qt, q_tile, -1),
+            q_ids.reshape(qt, q_tile),
+            cand_i.reshape(qt, q_tile, V),
+        ),
+    )
+    return d.reshape(Q, cfg.k), i.reshape(Q, cfg.k)
 
 
 @functools.partial(
@@ -49,6 +101,41 @@ from mpi_knn_tpu.parallel.partition import (
 def _pallas_all_knn(
     queries, corpus, cfg, q_tile, c_tile, m_corpus, all_pairs, variant
 ):
+    if cfg.precision_policy == "mixed" and mixed_applies(cfg.k, c_tile):
+        # pass 1 IN-KERNEL: the compress dot (bf16 DEFAULT) plus the
+        # overfetch selection run in VMEM; each tile emits 4k compressed-
+        # key survivors instead of k. Pass 2 (exact HIGHEST rerank of the
+        # gathered survivors) is XLA-side, shared with the serial/ring
+        # pipeline's rerank helper.
+        ov = overfetch_width(cfg.k, c_tile)
+        common = dict(
+            m_corpus=m_corpus,
+            k=ov,
+            q_tile=q_tile,
+            c_tile=c_tile,
+            exclude_self=cfg.exclude_self,
+            exclude_zero=cfg.exclude_zero,
+            all_pairs=all_pairs,
+            zero_eps=cfg.zero_eps,
+            compress=True,
+        )
+        if variant == "sweep":
+            _, cand_i = fused_knn_sweep(queries, corpus, **common)
+        else:
+            cand_d, cand_i = fused_knn_tiles(queries, corpus, **common)
+            # the tiles kernel emits 4k survivors PER corpus tile
+            # (n_c·4k per query); preselect the global 4k by the same
+            # compressed keys before the gather, or the pass-2 cost —
+            # the (q_tile, V, d) gather and the HIGHEST rerank dot —
+            # would scale with the tile count instead of the promised
+            # O(q·4k·d). Compressed keys are comparable across tiles
+            # (one rounding rule), so this is the paper's global
+            # overfetch; invalid (+inf, -1) slots sort to the end.
+            if cand_i.shape[1] > ov:
+                _, cand_i = smallest_k(cand_d, cand_i, ov, method="exact")
+        return _mixed_exact_finish(
+            queries, corpus, cand_i, cfg, q_tile, all_pairs
+        )
     if variant == "sweep":
         # the sweep kernel merges in VMEM scratch; its output IS the final
         # top-k (exact merge — cfg.topk_method does not apply here). The

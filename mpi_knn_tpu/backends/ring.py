@@ -34,6 +34,13 @@ Memory per device is O(m/P · d) for the rotating block plus the O(q_local · k)
 carry — the corpus-ring is the same skeleton ring-attention uses for long
 sequences, applied to a corpus axis (SURVEY.md §2a), and corpus capacity
 scales linearly with devices.
+
+``cfg.precision_policy="mixed"`` composes with the ring for free: the
+compress-and-rerank pipeline lives inside the shared per-tile reduction
+(backends.serial.local_tile_topk via merge_tiles_into_carry), so each
+round's compress dot and exact rerank both run against the RESIDENT block
+— nothing about the rotation, the collective schedule, or the carry type
+changes, and the carry stays exact f32 across rounds.
 """
 
 from __future__ import annotations

@@ -16,6 +16,13 @@ rolling the padded corpus r blocks forward before sharding.
 ``stop_after_rounds`` is the fault-injection hook (SURVEY.md §6 "failure
 detection / fault injection"): tests kill the run at an arbitrary round and
 assert the resumed result is bit-identical to an uninterrupted one.
+
+``cfg.precision_policy="mixed"`` changes nothing here by construction: the
+compress/rerank passes complete inside each round's tile reduction (the
+rerank runs against the resident block before the round returns), so the
+checkpointed carry is the same exact-f32 (q, k) layout in either policy and
+a checkpoint written under one policy is invalidated only by the config
+fingerprint — never by a layout mismatch.
 """
 
 from __future__ import annotations

@@ -26,6 +26,7 @@ import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import pairwise_dist, sq_norms
+from mpi_knn_tpu.ops.rerank import compress_rerank_tile
 from mpi_knn_tpu.ops.topk import (
     cascade_smallest_k,
     init_topk,
@@ -75,6 +76,43 @@ def masked_dist_tile(
     )
 
 
+def local_tile_topk(
+    q_x: jax.Array,
+    q_ids: jax.Array,
+    q_sq: jax.Array | None,
+    blk: jax.Array,
+    blk_ids: jax.Array,
+    blk_sq: jax.Array | None,
+    cfg: KNNConfig,
+    out_dtype,
+):
+    """One corpus tile's (q, k) survivors — the per-tile reduction both
+    merge schedules share, switched on ``cfg.precision_policy``:
+
+    - "exact": one distance pass at ``cfg.matmul_precision`` (HIGHEST by
+      default for f32), then ``smallest_k`` per ``cfg.topk_method``;
+    - "mixed": the compress-and-rerank two-pass pipeline (ops/rerank.py) —
+      a DEFAULT-precision bf16 compress dot overfetches 4k candidates, a
+      HIGHEST rerank of the gathered survivors finishes exactly. The tile's
+      contribution to any downstream merge is exact-f32 either way, so the
+      carry/checkpoint algebra is policy-independent.
+    """
+    if cfg.precision_policy == "mixed":
+        ld, li = compress_rerank_tile(
+            q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg
+        )
+        return ld.astype(out_dtype), li
+    d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
+    return smallest_k(
+        d.astype(out_dtype),
+        blk_ids,
+        cfg.k,
+        method=cfg.topk_method,
+        recall_target=cfg.recall_target,
+        block=cfg.topk_block,
+    )
+
+
 def knn_tile_step(
     q_x: jax.Array,
     q_ids: jax.Array,
@@ -89,11 +127,20 @@ def knn_tile_step(
     """One fused (query_tile × corpus_tile) step: distances → masks → merged
     top-k, streamed into the carry. The ring backends' per-round body (a
     rotating block is inherently stream-merged)."""
-    d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
-    all_d = jnp.concatenate([carry_d, d.astype(carry_d.dtype)], axis=-1)
-    all_i = jnp.concatenate(
-        [carry_i, jnp.broadcast_to(blk_ids[None, :], d.shape)], axis=-1
-    )
+    if cfg.precision_policy == "mixed":
+        # two-pass tile reduction to k exact survivors first, then a narrow
+        # (2k-wide) merge into the carry — the carry itself stays exact
+        ld, li = local_tile_topk(
+            q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg, carry_d.dtype
+        )
+        all_d = jnp.concatenate([carry_d, ld], axis=-1)
+        all_i = jnp.concatenate([carry_i, li], axis=-1)
+    else:
+        d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
+        all_d = jnp.concatenate([carry_d, d.astype(carry_d.dtype)], axis=-1)
+        all_i = jnp.concatenate(
+            [carry_i, jnp.broadcast_to(blk_ids[None, :], d.shape)], axis=-1
+        )
     return smallest_k(
         all_d,
         all_i,
@@ -157,21 +204,24 @@ def merge_tiles_into_carry(
       per tile. Measured faster on v5e (BASELINE.md r3), now the default.
     - "stream": carry threaded through the tile scan — the reference's
       accumulate-as-you-go shape (``knn-serial.c:86-91``), batched.
+
+    Under ``cfg.precision_policy="mixed"`` the per-tile reduction in BOTH
+    schedules is the compress-and-rerank pipeline (ops/rerank.py): the wide
+    DEFAULT-precision dot and the 4k overfetch happen inside the tile, the
+    HIGHEST rerank finishes it, and what reaches the merges here is already
+    exact — the schedules, the cascade, and the ring's per-round streaming
+    merge are untouched by the policy.
     """
     if cfg.merge_schedule == "twolevel":
 
         def local(_, tile):
             blk, blk_ids, blk_sq = tile
-            d = masked_dist_tile(q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg)
-            ld, li = smallest_k(
-                d.astype(carry_d.dtype),
-                blk_ids,
-                cfg.k,
-                method=cfg.topk_method,
-                recall_target=cfg.recall_target,
-                block=cfg.topk_block,
+            # per-tile reduction honors cfg.precision_policy (exact single
+            # pass vs compress-and-rerank); either way k exact-f32
+            # survivors per tile feed the level-2 cascade
+            return None, local_tile_topk(
+                q_x, q_ids, q_sq, blk, blk_ids, blk_sq, cfg, carry_d.dtype
             )
-            return None, (ld, li)
 
         _, (ld, li) = jax.lax.scan(local, None, (tiles, tile_ids, tile_sqs))
         n_tiles = ld.shape[0]

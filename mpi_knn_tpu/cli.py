@@ -25,6 +25,7 @@ from mpi_knn_tpu.config import (
     BACKENDS,
     MERGE_SCHEDULES,
     METRICS,
+    PRECISION_POLICIES,
     TIE_BREAKS,
     TOPK_METHODS,
     KNNConfig,
@@ -74,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--corpus-tile", type=int, default=2048)
     k.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16", "float64"])
+    k.add_argument("--precision-policy", choices=list(PRECISION_POLICIES),
+                   default="exact",
+                   help="distance-pipeline precision: exact (one-pass "
+                   "HIGHEST dot) or mixed (compress-and-rerank: single-pass "
+                   "bf16 dot overfetches 4k candidates, exact HIGHEST "
+                   "rerank of the survivors — the TPU-KNN recipe; requires "
+                   "--dtype float32)")
     k.add_argument("--topk-method", choices=list(TOPK_METHODS), default="exact",
                    help="exact lax.top_k; approx_min_k partial reduction; or "
                    "block — exact narrow-sort two-level reduction (fastest "
@@ -288,6 +296,7 @@ def main(argv=None) -> int:
         query_tile=args.query_tile,
         corpus_tile=args.corpus_tile,
         dtype=args.dtype,
+        precision_policy=args.precision_policy,
         topk_method=args.topk_method,
         topk_block=args.topk_block,
         merge_schedule=args.merge_schedule,

@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
 TOPK_METHODS = ("exact", "approx", "approx-rerank", "block", "bf16")
+PRECISION_POLICIES = ("exact", "mixed")
 MERGE_SCHEDULES = ("stream", "twolevel")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
@@ -76,6 +77,21 @@ class KNNConfig:
     # DEFAULT truncates f32 operands to bf16 — measured ~0.3% recall@10 loss),
     # DEFAULT for bf16 inputs. Explicit "default"/"high"/"highest" overrides.
     matmul_precision: Optional[str] = None
+    # distance-pipeline precision structure (ops/rerank.py):
+    # "exact"  — one-pass distances with the dot at matmul_precision
+    #            (today's behavior, HIGHEST by default for f32);
+    # "mixed"  — the TPU-KNN compress-and-rerank recipe: pass 1 computes the
+    #            tile's distances with a single-pass bf16 MXU dot
+    #            (Precision.DEFAULT, f32 accumulation) and overfetches 4k
+    #            candidates per query; pass 2 gathers only the survivors'
+    #            corpus rows and recomputes their distances exactly
+    #            (HIGHEST, mask_tile semantics re-applied on exact values)
+    #            before the final top-k. The O(q·c·d) FLOPs run at full MXU
+    #            rate; only O(q·4k·d) runs multi-pass. Requires
+    #            dtype="float32" and matmul_precision=None (the policy owns
+    #            both dots' precisions); the recall gate measures the loss
+    #            (>= 0.999 recall@10 on the tier-1 synthetic gate).
+    precision_policy: str = "exact"
     # mean-center data before L2 distance computation (host-side, one pass).
     # L2 distances are translation-invariant, so results are mathematically
     # unchanged — but cancellation error in the matmul form scales with the
@@ -156,6 +172,25 @@ class KNNConfig:
                 f"merge_schedule must be one of {MERGE_SCHEDULES}, got "
                 f"{self.merge_schedule!r}"
             )
+        if self.precision_policy not in PRECISION_POLICIES:
+            raise ValueError(
+                f"precision_policy must be one of {PRECISION_POLICIES}, got "
+                f"{self.precision_policy!r}"
+            )
+        if self.precision_policy == "mixed":
+            if self.dtype != "float32":
+                raise ValueError(
+                    "precision_policy='mixed' requires dtype='float32' "
+                    f"(got {self.dtype!r}): bf16 inputs already run the "
+                    "single-pass dot everywhere, and the f64 debug mode "
+                    "must not downcast"
+                )
+            if self.matmul_precision is not None:
+                raise ValueError(
+                    "precision_policy='mixed' owns both dot precisions "
+                    "(DEFAULT compress, HIGHEST rerank); matmul_precision "
+                    f"must be None, got {self.matmul_precision!r}"
+                )
         if self.topk_block < 1:
             raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")
         if self.k < 1:

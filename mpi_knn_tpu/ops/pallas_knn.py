@@ -70,26 +70,34 @@ def _k_smallest_sweep(d, cand_ids, k, col_offset=None):
 
 def _masked_tile_dists(
     q, c, qi, ci, q_tile, c_tile, m_corpus, exclude_self, exclude_zero,
-    all_pairs, zero_eps, precision,
+    all_pairs, zero_eps, precision, compress=False,
 ):
     """(q_tile, c_tile) masked squared-L2 distances + global candidate ids —
-    the kernel-side mirror of ops.distance.pairwise_sq_l2 + ops.topk.mask_tile."""
+    the kernel-side mirror of ops.distance.pairwise_sq_l2 + ops.topk.mask_tile.
+
+    ``compress=True`` is the mixed-precision policy's pass 1 (ops/rerank.py):
+    the dot runs single-pass on explicitly bf16-rounded operands (DEFAULT
+    precision, f32 accumulation — the explicit cast makes CPU interpret runs
+    measure the same rounding the MXU applies), and the zero-by-value mask
+    is SKIPPED — compressed values are preselect keys only; the exact-finish
+    rerank re-applies zero-exclusion on exact distances. Padding and self
+    masks are id-based (precision-independent) and stay."""
     q_sq = jnp.sum(q * q, axis=-1, keepdims=True)  # (q_tile, 1)
     c_sq = jnp.sum(c * c, axis=-1, keepdims=True).T  # (1, c_tile)
     # MXU: one matmul per tile; f32 accumulation
     xy = jax.lax.dot_general(
-        q,
-        c,
+        q.astype(jnp.bfloat16) if compress else q,
+        c.astype(jnp.bfloat16) if compress else c,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=precision,
+        precision=jax.lax.Precision.DEFAULT if compress else precision,
     )
     d = jnp.maximum(q_sq - 2.0 * xy + c_sq, 0.0)
 
     col = jax.lax.broadcasted_iota(jnp.int32, (q_tile, c_tile), 1)
     col_global = ci * c_tile + col  # candidate global ids
     invalid = col_global >= m_corpus  # divisibility padding rows
-    if exclude_zero:
+    if exclude_zero and not compress:
         # same semantics as ops.topk.mask_tile: explicit absolute eps wins,
         # else relative to the pair magnitude
         thresh = zero_eps if zero_eps > 0.0 else _ZERO_RTOL * (q_sq + c_sq)
@@ -116,12 +124,14 @@ def _fused_knn_kernel(
     all_pairs: bool,
     zero_eps: float,  # >0: absolute threshold; 0: relative (rtol · scale)
     precision,
+    compress: bool,  # mixed policy pass 1: bf16 DEFAULT dot, zero-mask off
 ):
     qi = pl.program_id(0)
     ci = pl.program_id(1)
     d, _ = _masked_tile_dists(
         q_ref[:], c_ref[:], qi, ci, q_tile, c_tile, m_corpus,
         exclude_self, exclude_zero, all_pairs, zero_eps, precision,
+        compress=compress,
     )
     # ids are affine in the column within a tile -> affine fast path
     outd_ref[0], outi_ref[0] = _k_smallest_sweep(
@@ -146,6 +156,7 @@ def _fused_knn_sweep_kernel(
     all_pairs: bool,
     zero_eps: float,
     precision,
+    compress: bool,
 ):
     """Sweep variant: TPU grid cells execute SEQUENTIALLY, so for a fixed
     query tile the corpus-tile loop (minor grid axis) carries the running
@@ -158,6 +169,7 @@ def _fused_knn_sweep_kernel(
     d, _ = _masked_tile_dists(
         q_ref[:], c_ref[:], qi, ci, q_tile, c_tile, m_corpus,
         exclude_self, exclude_zero, all_pairs, zero_eps, precision,
+        compress=compress,
     )
     new_d, new_i = _k_smallest_sweep(d, None, k, col_offset=ci * c_tile)
 
@@ -200,6 +212,7 @@ def fused_knn_tiles(
     all_pairs: bool = True,
     zero_eps: float = 0.0,
     precision=None,
+    compress: bool = False,
     interpret: bool | None = None,
 ):
     """Per-(query-tile, corpus-tile) local top-k.
@@ -227,9 +240,11 @@ def fused_knn_tiles(
         all_pairs=all_pairs,
         zero_eps=zero_eps,
         # recall-parity anchor, same as ops.distance: full f32 by default
+        # (compress mode overrides to the bf16 DEFAULT dot in-kernel)
         precision=(
             jax.lax.Precision.HIGHEST if precision is None else precision
         ),
+        compress=compress,
     )
     outd, outi = pl.pallas_call(
         kernel,
@@ -276,6 +291,7 @@ def fused_knn_sweep(
     all_pairs: bool = True,
     zero_eps: float = 0.0,
     precision=None,
+    compress: bool = False,
     interpret: bool | None = None,
 ):
     """Full fused all-kNN in one kernel: the corpus-tile sweep runs on the
@@ -309,6 +325,7 @@ def fused_knn_sweep(
         precision=(
             jax.lax.Precision.HIGHEST if precision is None else precision
         ),
+        compress=compress,
     )
     return pl.pallas_call(
         kernel,

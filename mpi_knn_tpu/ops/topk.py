@@ -66,6 +66,22 @@ def _pad_lanes(dists: jax.Array, ids: jax.Array, multiple: int = 128):
     return dists, ids
 
 
+def preselect_smallest(dists: jax.Array, n: int, half_width: bool = False):
+    """Column positions of each row's ``n`` smallest entries — the overfetch
+    preselect shared by ``smallest_k``'s "bf16" method and the mixed-
+    precision compress pass (ops/rerank.py). With ``half_width`` the sort
+    keys are rounded to bf16 first (monotone in the f32 values they round
+    from — narrower VPU compares); either way the returned positions index
+    the ORIGINAL columns, so the caller reranks/gathers exact values."""
+    keys = (
+        dists.astype(jnp.bfloat16)
+        if half_width and dists.dtype == jnp.float32
+        else dists
+    )
+    _, pos = jax.lax.top_k(-keys, n)
+    return pos
+
+
 def smallest_k(
     dists: jax.Array,
     ids: jax.Array,
@@ -139,7 +155,7 @@ def smallest_k(
         # bf16 value at the boundary — the recall gate measures it (the
         # method makes no exactness claim).
         pre = 4 * k
-        _, pos = jax.lax.top_k(-dists.astype(jnp.bfloat16), pre)
+        pos = preselect_smallest(dists, pre, half_width=True)
         dists = jnp.take_along_axis(dists, pos, axis=-1)
         ids = jnp.take_along_axis(ids, pos, axis=-1)
         c = pre

@@ -276,6 +276,41 @@ def backward_slice(
             for sc, sn in sites.get(c, ()):
                 caller = module.instr(sc, sn)
                 pi = instr.param_index
+                if caller.opcode == "conditional":
+                    # A conditional's operand 0 is the PREDICATE/branch
+                    # index; branch b's computation receives call-site
+                    # operand b+1 (same layout for the indexed
+                    # branch_computations form and true/false_computation).
+                    # Mapping parameter(0) to operand pi==0 pointed the
+                    # branch argument at the predicate — a missed
+                    # dependence (ADVICE r5), i.e. an UNDER-approximation,
+                    # the one direction the module contract forbids: a
+                    # permute inside a branch could be falsely certified
+                    # compute-independent. Branch computations take exactly
+                    # one parameter, so parameter(0) is the only shape with
+                    # a precise target; anything else (and a branch whose
+                    # operand is missing) goes conservative-flat like the
+                    # comparator path.
+                    branch_args = [
+                        caller.operands[bi + 1]
+                        for bi, callee in enumerate(caller.called)
+                        if callee == c and bi + 1 < len(caller.operands)
+                    ]
+                    if pi == 0 and branch_args:
+                        for o in branch_args:
+                            work.append((sc, o, idx))
+                        # the branch body cannot issue before the
+                        # predicate/branch index is computed — a scheduling
+                        # edge every instruction in the branch inherits;
+                        # dropping it would be the same under-approximation
+                        # in a different operand (a permute in a branch
+                        # whose PREDICATE derives from the compute)
+                        if caller.operands:
+                            work.append((sc, caller.operands[0], ()))
+                    else:
+                        for o in caller.operands:
+                            work.append((sc, o, ()))
+                    continue
                 if pi is not None and pi < len(caller.operands):
                     work.append((sc, caller.operands[pi], idx))
                 else:  # comparator/arity mismatch: conservative, flat

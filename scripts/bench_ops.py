@@ -1,0 +1,189 @@
+"""Op-level microbenchmarks: the two primitives that own the all-kNN
+budget, timed in isolation so a per-op perf trajectory exists even when the
+full driver bench watchdogs (BENCH_WATCHDOG_S fires on a wedged device
+transport and reports only the timeout).
+
+Two families, one JSON artifact:
+
+- ``pairwise_sq_l2`` at each precision configuration: the three explicit
+  dot precisions (``default``/``high``/``highest``) plus the two
+  ``precision_policy`` pipelines — ``policy-exact`` (one HIGHEST pass +
+  exact top-k, the library default end to end) and ``policy-mixed`` (the
+  compress-and-rerank two-pass pipeline, ops/rerank.py) — so the mixed
+  policy's headline claim (compress FLOPs at single-pass rate buying back
+  the HIGHEST multi-pass cost) is measurable per-op. The policy rows time
+  distance+selection together (the policy changes where selection work
+  happens, so distance-only timings of it would mislead); the bare
+  precision rows time the distance tile alone.
+- ``smallest_k`` at each method (``exact``/``approx``/``approx-rerank``/
+  ``block``/``bf16``) over a fixed pre-computed distance tile.
+
+CPU numbers say nothing absolute about the TPU — what they pin is the
+RELATIVE trajectory per op across PRs, on the platform CI always has
+(the same rationale as ring_scaling_cpu.py). On a real chip the same
+script measures the real thing.
+
+Usage::
+
+    python scripts/bench_ops.py [--out measurements/bench_ops.json]
+        [--q 1024] [--c 8192] [--d 784] [--k 10] [--reps 5]
+
+Output: one JSON document with environment metadata and a ``results`` list
+of ``{op, variant, median_s, min_s, reps_s}`` rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _time(fn, reps: int):
+    """Median/min wall-clock of ``fn`` (jitted; first call compiles and is
+    discarded). ``fn`` must return a device array to synchronize on."""
+    fn().block_until_ready()  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn().block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="measurements/bench_ops.json")
+    ap.add_argument("--q", type=int, default=1024)
+    ap.add_argument("--c", type=int, default=8192)
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_knn_tpu.config import TOPK_METHODS, KNNConfig
+    from mpi_knn_tpu.ops.distance import pairwise_sq_l2, sq_norms
+    from mpi_knn_tpu.ops.rerank import compress_rerank_tile, mixed_applies
+    from mpi_knn_tpu.ops.topk import mask_tile, smallest_k
+
+    q, c, d, k, reps = args.q, args.c, args.d, args.k, args.reps
+    rng = np.random.default_rng(0)
+    # integer-pixel magnitudes, centered — the headline workload's regime,
+    # where bf16 compression is genuinely lossy (see BASELINE.md precision
+    # A/B); zero-noise data would flatter the mixed pipeline
+    X = np.rint(rng.random((c, d)) * 255.0).astype(np.float32)
+    X -= X.mean(axis=0)
+    Q = jax.device_put(jnp.asarray(X[:q]))
+    C = jax.device_put(jnp.asarray(X))
+    q_ids = jnp.arange(q, dtype=jnp.int32)
+    c_ids = jnp.arange(c, dtype=jnp.int32)
+    q_sq = sq_norms(Q).block_until_ready()
+    c_sq = sq_norms(C).block_until_ready()
+
+    results = []
+
+    def record(op, variant, times):
+        row = {
+            "op": op,
+            "variant": variant,
+            "median_s": round(statistics.median(times), 6),
+            "min_s": round(min(times), 6),
+            "reps_s": [round(t, 6) for t in times],
+        }
+        results.append(row)
+        print(f"{op:16s} {variant:16s} median {row['median_s']}s", flush=True)
+
+    # Every device array is an explicit jit ARGUMENT — a device array
+    # captured in a jit closure is a compile-time constant, and XLA
+    # constant-folds the whole benchmark body into the executable (observed:
+    # a "7 µs" top-k that was really a table lookup).
+
+    # -- distance tile at each explicit dot precision (tile only) ---------
+    @functools.partial(jax.jit, static_argnames=("prec",))
+    def dist_at(Q, C, qs, cs, prec):
+        return pairwise_sq_l2(Q, C, x_sq=qs, y_sq=cs, precision=prec)
+
+    for prec in ("default", "high", "highest"):
+        record(
+            "pairwise_sq_l2",
+            f"precision-{prec}",
+            _time(lambda: dist_at(Q, C, q_sq, c_sq, prec=prec), reps),
+        )
+
+    # -- the two precision POLICIES, distance + selection end to end ------
+    exact_cfg = KNNConfig(k=k, query_tile=q, corpus_tile=c)
+    mixed_cfg = exact_cfg.replace(precision_policy="mixed")
+    if not mixed_applies(k, c):
+        print(f"note: 4k={4 * k} >= c={c}; policy-mixed degenerates to "
+              "exact at these shapes", file=sys.stderr)
+
+    @jax.jit
+    def policy_exact(Q, C, qs, cs, q_ids, c_ids):
+        dist = pairwise_sq_l2(Q, C, x_sq=qs, y_sq=cs, precision=None)
+        dist = mask_tile(dist, c_ids, query_ids=q_ids,
+                         scale=qs[:, None] + cs[None, :])
+        return smallest_k(dist, c_ids, k, method="exact")[0]
+
+    @jax.jit
+    def policy_mixed(Q, C, qs, cs, q_ids, c_ids):
+        return compress_rerank_tile(
+            Q, q_ids, qs, C, c_ids, cs, mixed_cfg
+        )[0]
+
+    for name, fn in (("policy-exact", policy_exact),
+                     ("policy-mixed", policy_mixed)):
+        record(
+            "dist_topk_tile", name,
+            _time(lambda: fn(Q, C, q_sq, c_sq, q_ids, c_ids), reps),
+        )
+
+    # -- smallest_k at every method over a fixed masked tile --------------
+    dist_fixed = jax.jit(
+        lambda Q, C, qs, cs, c_ids, q_ids: mask_tile(
+            pairwise_sq_l2(Q, C, x_sq=qs, y_sq=cs),
+            c_ids,
+            query_ids=q_ids,
+            scale=qs[:, None] + cs[None, :],
+        )
+    )(Q, C, q_sq, c_sq, c_ids, q_ids).block_until_ready()
+
+    @functools.partial(jax.jit, static_argnames=("method",))
+    def select(dist, c_ids, method):
+        return smallest_k(dist, c_ids, k, method=method,
+                          recall_target=0.95)[0]
+
+    for method in TOPK_METHODS:
+        record(
+            "smallest_k", method,
+            _time(lambda: select(dist_fixed, c_ids, method=method), reps),
+        )
+
+    doc = {
+        "schema": "bench_ops.v1",
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "jax_version": jax.__version__,
+        "shapes": {"q": q, "c": c, "d": d, "k": k},
+        "reps": reps,
+        "results": results,
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

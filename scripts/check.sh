@@ -47,6 +47,10 @@ if [ "$FAST" = 0 ]; then
 fi
 
 note "static lint of every backend's compiled program (mpi-knn lint)"
+# the default sweep is the full backend × metric × dtype matrix PLUS the
+# precision_policy=mixed cells for every backend × metric — R3 certifies
+# the compress-and-rerank dot contract there (exactly one DEFAULT compress
+# dot per tile computation, rerank at HIGHEST); any finding fails the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
 
 note "tier-1 pytest (the ROADMAP.md gate)"

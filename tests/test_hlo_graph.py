@@ -123,6 +123,51 @@ ENTRY %e.1 (p.1: f32[2]) -> (f32[2], s32[2]) {
     assert mt.instr("e.1", "t.1").type_str == "(f32[2]{0}, s32[2]{0})"
 
 
+_COND_ARGS = """\
+HloModule condargs, entry_computation_layout={(f32[4,4]{1,0}, pred[])->f32[4,4]{1,0}}
+
+%b0.1 (p0.1: f32[4,4]) -> f32[4,4] {
+  %p0.1 = f32[4,4]{1,0} parameter(0)
+  ROOT %cp.1 = f32[4,4]{1,0} collective-permute(%p0.1), source_target_pairs={{0,1},{1,0}}
+}
+
+%b1.1 (p1.1: f32[4,4]) -> f32[4,4] {
+  %p1.1 = f32[4,4]{1,0} parameter(0)
+  ROOT %neg.1 = f32[4,4]{1,0} negate(%p1.1)
+}
+
+ENTRY %main.1 (a.1: f32[4,4], pr.1: pred[]) -> f32[4,4] {
+  %a.1 = f32[4,4]{1,0} parameter(0)
+  %pr.1 = pred[] parameter(1)
+  %d.1 = f32[4,4]{1,0} dot(%a.1, %a.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %e.1 = f32[4,4]{1,0} negate(%a.1)
+  ROOT %c.1 = f32[4,4]{1,0} conditional(%pr.1, %d.1, %e.1), branch_computations={%b0.1, %b1.1}
+}
+"""
+
+
+def test_conditional_branch_parameter_maps_to_branch_operand():
+    """Regression (ADVICE r5): a conditional's operand 0 is the predicate;
+    branch b's parameter(0) is call-site operand b+1. The old mapping sent
+    parameter(0) to operand 0, so a collective-permute inside a branch
+    whose argument derives from a dot was falsely certified
+    compute-independent — an under-approximation, the one direction the
+    module's soundness contract forbids."""
+    m = parse_hlo(_COND_ARGS)
+    sl0 = backward_slice(m, "b0.1", "cp.1")
+    # branch 0's argument is %d.1 (the dot) — the permute DOES depend on it
+    assert ("main.1", "d.1") in sl0
+    # ...and the mapping is precise: branch 1's argument is not dragged in
+    assert ("main.1", "e.1") not in sl0
+    # the predicate is a scheduling edge for everything inside a branch
+    # (the branch cannot issue before the branch index is known)
+    assert ("main.1", "pr.1") in sl0
+    # branch 1 symmetrically sees only its own argument
+    sl1 = backward_slice(m, "b1.1", "neg.1")
+    assert ("main.1", "e.1") in sl1
+    assert ("main.1", "d.1") not in sl1
+
+
 def test_multi_computation_calls_share_one_callee():
     """Two call sites into the same computation: a parameter must continue
     at BOTH call sites (the conservative over-approximation documented in
