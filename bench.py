@@ -114,6 +114,25 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    # BENCH_RING_SCHEDULE=bidir: full-duplex ring rotation (both torus
+    # directions at once, floor(P/2)+1 rounds). The knob only means anything
+    # on a ring backend — setting it with a single-device backend would
+    # silently measure an identical program under a different label, so the
+    # conflicting combination is refused loudly (same treatment as
+    # BENCH_PRECISION × BENCH_PRECISION_POLICY above).
+    ring_schedule = os.environ.get("BENCH_RING_SCHEDULE", "uni")
+    if ring_schedule != "uni" and backend not in ("ring", "ring-overlap"):
+        print(
+            json.dumps({
+                "error": f"BENCH_RING_SCHEDULE={ring_schedule} conflicts "
+                f"with BENCH_BACKEND={backend}: the ring schedule only "
+                "exists on ring/ring-overlap backends — an A/B sweep here "
+                "would record identical single-device runs mislabeled as "
+                "schedule variants"
+            }),
+            file=sys.stderr,
+        )
+        return 2
     # BENCH_CENTER=0: skip mean-centering — read ONCE; the zero_eps pairing
     # below derives from the same bool so the two can never desync
     center = os.environ.get("BENCH_CENTER", "1") != "0"
@@ -155,6 +174,7 @@ def main() -> int:
         # BENCH_RING_XFER=bfloat16 halves ICI bytes per ring hop (the knob
         # only matters for BENCH_BACKEND=ring/ring-overlap)
         ring_transfer_dtype=os.environ.get("BENCH_RING_XFER") or None,
+        ring_schedule=ring_schedule,
         # uncentered mode exists because raw MNIST pixels are small integers
         # — exactly representable even in bf16 — where *centered* values lose
         # mantissa bits. The relative zero-exclusion threshold is calibrated

@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "(one-pass HIGHEST distances) or mixed (the compress-"
                    "and-rerank pipeline, whose dot-precision contract R3 "
                    "certifies); repeatable")
+    p.add_argument("--schedule", action="append", choices=["uni", "bidir"],
+                   help="restrict to ring schedule(s): uni (one-directional "
+                   "rotation) or bidir (full-duplex counter-rotation, whose "
+                   "2-permutes-per-direction accounting R4 certifies); "
+                   "repeatable")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -79,6 +84,7 @@ def main(argv=None) -> int:
         and (not args.metric or t.metric in args.metric)
         and (not args.dtype or t.dtype in args.dtype)
         and (not args.policy or t.policy in args.policy)
+        and (not args.schedule or t.schedule in args.schedule)
     ]
     if not targets:
         print("error: no targets match the given filters", file=sys.stderr)

@@ -58,6 +58,7 @@ class TargetResult:
             "metric": self.target.metric,
             "dtype": self.target.dtype,
             "policy": self.target.policy,
+            "schedule": self.target.schedule,
             "ok": self.ok,
             "skipped": self.skipped,
             "rules_run": self.rules_run,

@@ -48,17 +48,26 @@ LINT_M_MIXED, LINT_CORPUS_TILE_MIXED = 256, 32
 
 @dataclasses.dataclass(frozen=True)
 class LintTarget:
-    """One cell of the backend × metric × dtype × precision-policy matrix."""
+    """One cell of the backend × metric × dtype × precision-policy ×
+    ring-schedule matrix (``schedule`` only varies for ring backends)."""
 
     backend: str
     metric: str
     dtype: str
     policy: str = "exact"
+    schedule: str = "uni"
 
     @property
     def label(self) -> str:
         base = f"{self.backend}/{self.metric}/{self.dtype}"
-        return base if self.policy == "exact" else f"{base}/{self.policy}"
+        if self.policy != "exact":
+            base = f"{base}/{self.policy}"
+        if self.schedule != "uni":
+            base = f"{base}/{self.schedule}"
+        return base
+
+
+RING_BACKENDS = ("ring", "ring-overlap")
 
 
 def default_targets() -> list[LintTarget]:
@@ -73,6 +82,15 @@ def default_targets() -> list[LintTarget]:
         LintTarget(b, m, "float32", "mixed")
         for b in LINT_BACKENDS
         for m in METRICS
+    ] + [
+        # the bidirectional ring schedule: ring backends only, float32, both
+        # policies — R4 certifies the counter-directed permute accounting
+        # (2 per direction) and R1 re-certifies overlap/blocking sequencing
+        # on the two-traveler step body
+        LintTarget(b, m, "float32", p, "bidir")
+        for b in RING_BACKENDS
+        for m in METRICS
+        for p in ("exact", "mixed")
     ]
 
 
@@ -92,6 +110,7 @@ def _base_cfg(target: LintTarget) -> KNNConfig:
             LINT_CORPUS_TILE_MIXED if mixed else LINT_CORPUS_TILE
         ),
         precision_policy=target.policy,
+        ring_schedule=target.schedule,
     )
 
 
@@ -208,10 +227,24 @@ def _lower_ring(target: LintTarget):
         "c_tile": c_tile,
         "acc_bytes": _acc_bytes(target.dtype),
         "ring_n": ring_n,
-        # the corpus block and its global-id row rotate together
-        "expected_permutes": 2,
+        "ring_schedule": target.schedule,
+        # the corpus block and its global-id row rotate together; the bidir
+        # schedule doubles that: one (block, ids) pair per torus direction,
+        # with counter-directed source_target_pairs (R4 checks both the
+        # count and the direction split)
+        "expected_permutes": 4 if target.schedule == "bidir" else 2,
         **_mixed_meta(target, q_tile, c_tile),
     }
+    if target.schedule == "bidir":
+        # R2: the second resident traveler is a REGISTERED intermediate —
+        # two (c_pad/ring_n, d) blocks live per device instead of one. The
+        # entry-input floor (the whole padded corpus) already dominates at
+        # lint shapes, but the budget must name the allowance rather than
+        # ride on that coincidence.
+        block_elems = (c_pad // ring_n) * LINT_D
+        meta["extra_elems"] = max(
+            meta.get("extra_elems", 0), 2 * block_elems
+        )
     return lowered, cfg, meta
 
 
@@ -283,25 +316,32 @@ def lower_target(target: LintTarget):
 # artifact's historical shapes.
 
 
-def lower_ring_driver(driver: str, variant: str):
-    """HLO texts for one (driver, schedule) of the ring-overlap artifact.
+def lower_ring_driver(driver: str, variant: str, schedule: str = "uni"):
+    """HLO texts for one (driver, variant, schedule) of the ring-overlap
+    artifact.
 
     ``driver``: ``"scan"`` (the headline ``lax.scan`` ring) or
     ``"one_round"`` (the resumable single-round jit). ``variant``:
-    ``"overlap"`` or ``"blocking"``.
+    ``"overlap"`` or ``"blocking"``. ``schedule``: ``"uni"`` or ``"bidir"``
+    (the full-duplex rotation; the one_round form is lowered at a
+    non-degenerate round, ``merge_bwd=True``, where both travelers merge).
     """
     from mpi_knn_tpu.backends.ring import (
         _ring_knn_sharded,
         parse_ring_mesh,
         ring_tiles,
     )
-    from mpi_knn_tpu.backends.ring_resumable import _ring_one_round
+    from mpi_knn_tpu.backends.ring_resumable import (
+        _ring_one_round,
+        _ring_one_round_bidir,
+    )
     from mpi_knn_tpu.ops.topk import init_topk
     from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 
     mesh = make_ring_mesh(None)
     q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
-    cfg = KNNConfig(k=4, query_tile=8, corpus_tile=16)
+    cfg = KNNConfig(k=4, query_tile=8, corpus_tile=16,
+                    ring_schedule=schedule)
     m, nq, d = LINT_M, LINT_NQ, LINT_D
     q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
     overlap = variant == "overlap"
@@ -311,7 +351,25 @@ def lower_ring_driver(driver: str, variant: str):
         jnp.zeros((c_pad, d), jnp.float32),
         jnp.zeros((c_pad,), jnp.int32),
     )
-    if driver == "one_round":
+    if driver == "one_round" and schedule == "bidir":
+        lowered = _ring_one_round_bidir.lower(
+            *data[:2],
+            data[2],
+            data[3],
+            data[2],
+            data[3],
+            *init_topk(q_pad, cfg.k, dtype=jnp.float32),
+            cfg,
+            overlap,
+            mesh,
+            axis,
+            q_tile,
+            c_tile,
+            q_axis=q_axis,
+            rotate=True,
+            merge_bwd=True,
+        )
+    elif driver == "one_round":
         lowered = _ring_one_round.lower(
             *data,
             *init_topk(q_pad, cfg.k, dtype=jnp.float32),

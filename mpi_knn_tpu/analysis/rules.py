@@ -24,10 +24,12 @@ Shipped rules:
   with bf16 operands must accumulate wider (bf16→bf16 dots lose the MXU's
   f32 accumulator).
 - **R4-collective** — collective accounting. Ring backends must contain
-  exactly the expected corpus-rotation ``collective-permute`` pair with
-  ring-shaped ``source_target_pairs`` and nothing else; single-device
-  backends must contain no collectives at all (a stray ``all-gather`` /
-  ``all-reduce`` is a sharding leak).
+  exactly the expected corpus-rotation ``collective-permute``s with
+  ring-shaped ``source_target_pairs`` and nothing else (uni: one block+ids
+  pair, forward; bidir: two counter-directed pairs, 2 permutes per torus
+  direction — wrong-direction or missing permutes are findings);
+  single-device backends must contain no collectives at all (a stray
+  ``all-gather`` / ``all-reduce`` is a sharding leak).
 """
 
 from __future__ import annotations
@@ -557,12 +559,105 @@ def _permute_pairs(module: HloModule, comp: str, name: str):
     )
 
 
+def ring_rotation_pairs(ring_n: int) -> tuple[list, list]:
+    """The two legal rotation shapes on an n-ring: forward (i → i+1, the
+    reference's direction) and backward (i → i−1, the bidir schedule's
+    counter-rotation), as sorted source_target_pairs."""
+    fwd = sorted((i, (i + 1) % ring_n) for i in range(ring_n))
+    bwd = sorted((i, (i - 1) % ring_n) for i in range(ring_n))
+    return fwd, bwd
+
+
+def permute_direction_census(module: HloModule, ring_n: int) -> dict:
+    """Classify every collective-permute by rotation direction:
+    ``{"fwd": n, "bwd": n, "other": [instruction, ...]}``. The bidir
+    schedule must show an equal fwd/bwd split (one block + one ids permute
+    per direction) and nothing in ``other`` — a wrong-direction permute
+    would merge blocks in an order the round plan does not account for."""
+    fwd, bwd = ring_rotation_pairs(ring_n)
+    out: dict = {"fwd": 0, "bwd": 0, "other": []}
+    for comp, name in module.find(RING_COLLECTIVE):
+        if module.instr(comp, name).opcode.endswith("-done"):
+            continue
+        pairs = _permute_pairs(module, comp, name)
+        if pairs == fwd:
+            out["fwd"] += 1
+        elif pairs == bwd and ring_n > 2:
+            # n<=2: fwd and bwd coincide; classify as fwd above
+            out["bwd"] += 1
+        else:
+            out["other"].append(f"{comp}::{name}")
+    return out
+
+
+_WHILE_BODY_RE = re.compile(r"body=%?([\w.\-]+)")
+_WHILE_COND_RE = re.compile(r"condition=%?([\w.\-]+)")
+_INT_CONST_RE = re.compile(r"^\s*(-?\d+)\s*$")
+
+
+def _computation_closure(module: HloModule, root: str) -> set[str]:
+    """``root`` plus every computation transitively called from it."""
+    seen: set[str] = set()
+    work = [root]
+    while work:
+        c = work.pop()
+        if c in seen or c not in module.computations:
+            continue
+        seen.add(c)
+        for i in module.computations[c].instructions.values():
+            work.extend(i.called)
+    return seen
+
+
+def ring_scan_trip_counts(module: HloModule) -> list[int]:
+    """Trip counts of the rotation scan(s): every ``while`` whose body
+    (transitively) contains a ``collective-permute``, with the bound read
+    from the compare-against-constant in its condition computation. This is
+    how the bidir round-count claim (⌊P/2⌋+1 scan steps instead of P) is
+    machine-checked from the lowered HLO instead of trusted from the Python
+    that emitted it (tests/test_hlo_overlap.py; the dump artifact records
+    it in overlap_verdict.json). Inner tile loops (``lax.map`` over query
+    tiles, the corpus-tile scan) contain no collectives and are excluded by
+    construction."""
+    out = []
+    for c in module.computations.values():
+        for i in c.instructions.values():
+            if i.opcode != "while":
+                continue
+            mb = _WHILE_BODY_RE.search(i.attrs)
+            mc = _WHILE_COND_RE.search(i.attrs)
+            if not mb or not mc:
+                continue
+            has_permute = any(
+                instr.opcode.startswith(RING_COLLECTIVE)
+                for comp in _computation_closure(module, mb.group(1))
+                for instr in module.computations[comp].instructions.values()
+            )
+            if not has_permute:
+                continue
+            cond = module.computations.get(mc.group(1))
+            if cond is None:
+                continue
+            for ci in cond.instructions.values():
+                if ci.opcode != "compare" or "direction=LT" not in ci.attrs:
+                    continue
+                for op in ci.operands:
+                    src = cond.instructions.get(op)
+                    if src is None or src.opcode != "constant":
+                        continue
+                    m = _INT_CONST_RE.match(src.operand_text)
+                    if m:
+                        out.append(int(m.group(1)))
+    return out
+
+
 @register
 class R4Collectives(Rule):
     name = "R4-collective"
     description = (
-        "ring programs contain exactly the corpus-rotation permute pair "
-        "(ring-shaped source_target_pairs); single-device programs contain "
+        "ring programs contain exactly the corpus-rotation permutes "
+        "(uni: one forward pair; bidir: two counter-directed pairs) with "
+        "ring-shaped source_target_pairs; single-device programs contain "
         "no collectives — anything else is a sharding leak"
     )
 
@@ -605,6 +700,7 @@ class R4Collectives(Rule):
         permutes = found.get(RING_COLLECTIVE, [])
         expected = ctx.meta.get("expected_permutes")
         if stage == "before_opt" and expected is not None:
+            sched = ctx.meta.get("ring_schedule", "uni")
             if len(permutes) != expected:
                 out.append(
                     Finding(
@@ -612,30 +708,91 @@ class R4Collectives(Rule):
                         t.label,
                         stage,
                         f"expected exactly {expected} collective-permutes "
-                        f"(corpus block + ids rotation), found "
-                        f"{len(permutes)}",
+                        + (
+                            "(corpus block + ids rotation, one pair per "
+                            "torus direction)"
+                            if sched == "bidir"
+                            else "(corpus block + ids rotation)"
+                        )
+                        + f", found {len(permutes)}",
                         {"count": len(permutes)},
                     )
                 )
             ring_n = ctx.meta.get("ring_n")
-            want = (
-                sorted((i, (i + 1) % ring_n) for i in range(ring_n))
-                if ring_n
-                else None
-            )
-            for comp, name in permutes:
-                pairs = _permute_pairs(module, comp, name)
-                if want is not None and pairs is not None and pairs != want:
+            if ring_n and sched == "bidir":
+                # bidir accounting: 2 permutes per round per DIRECTION
+                # (block + ids), counter-directed source_target_pairs.
+                # A wrong-direction permute merges blocks in an order the
+                # ⌊P/2⌋+1-round plan does not account for (results wrong);
+                # a missing one means a traveler stopped moving (a silent
+                # fallback to half-duplex) — both are findings.
+                census = permute_direction_census(module, ring_n)
+                for instr_label in census["other"]:
                     out.append(
                         Finding(
                             self.name,
                             t.label,
                             stage,
-                            f"{comp}::{name} source_target_pairs {pairs} "
-                            f"is not the {ring_n}-ring rotation",
-                            {"pairs": pairs},
+                            f"{instr_label} source_target_pairs is neither "
+                            f"the forward nor the backward {ring_n}-ring "
+                            "rotation — a wrong-direction permute breaks "
+                            "the bidir round plan",
+                            {"census": {k: census[k] for k in ("fwd", "bwd")}},
                         )
                     )
+                if ring_n <= 2:
+                    # the two rotations coincide on a <=2-ring (the census
+                    # files everything under "fwd"), so only the combined
+                    # count is checkable — a per-direction split here would
+                    # fail every correct program
+                    if census["fwd"] + census["bwd"] != expected:
+                        out.append(
+                            Finding(
+                                self.name,
+                                t.label,
+                                stage,
+                                f"bidir schedule must issue {expected} "
+                                f"ring-rotation permutes on the {ring_n}-"
+                                "ring (directions coincide there), found "
+                                f"{census['fwd'] + census['bwd']}",
+                                {"census": {k: census[k]
+                                            for k in ("fwd", "bwd")}},
+                            )
+                        )
+                else:
+                    want_each = expected // 2
+                    for direction in ("fwd", "bwd"):
+                        if census[direction] != want_each:
+                            out.append(
+                                Finding(
+                                    self.name,
+                                    t.label,
+                                    stage,
+                                    "bidir schedule must rotate block + "
+                                    f"ids in the {direction} direction "
+                                    f"({want_each} permutes), found "
+                                    f"{census[direction]} — a missing "
+                                    "counter-directed permute is a silent "
+                                    "fallback to half-duplex",
+                                    {"census": {k: census[k]
+                                                for k in ("fwd", "bwd")}},
+                                )
+                            )
+            elif ring_n:
+                want, _ = ring_rotation_pairs(ring_n)
+                for comp, name in permutes:
+                    pairs = _permute_pairs(module, comp, name)
+                    if pairs is not None and pairs != want:
+                        out.append(
+                            Finding(
+                                self.name,
+                                t.label,
+                                stage,
+                                f"{comp}::{name} source_target_pairs "
+                                f"{pairs} is not the {ring_n}-ring rotation",
+                                {"pairs": pairs},
+                            )
+                        )
         elif stage == "after_opt" and not permutes:
             out.append(
                 Finding(

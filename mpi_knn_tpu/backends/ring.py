@@ -30,6 +30,24 @@ Two variants, matching the reference's pair but with the overlap done right:
   between them — XLA schedules the ICI DMA under the MXU matmul. This is the
   double-buffered pipeline the reference's non-blocking variant intended.
 
+Orthogonally, ``cfg.ring_schedule`` picks the rotation pattern:
+
+- ``"uni"`` (default): the reference's one-directional ring — P rounds, each
+  block moving rank → rank+1, using half of each full-duplex ICI link.
+- ``"bidir"``: every block circulates in BOTH torus directions at once (a
+  +1 and a −1 ``ppermute`` in the same scan step), so at round r a device
+  holds blocks i−r and i+r and merges both; the scan runs ⌊P/2⌋+1 rounds
+  instead of P. Total block-hops are conserved but travel concurrently over
+  the two link directions, halving the exposed communication critical path
+  (EQuARX's bidirectional-ring AllReduce moves data the same way, PAPERS.md).
+  Degenerate rounds merge once — round 0 both travelers are the own block;
+  at even P the antipodal block arrives from both sides on the last round —
+  via a ``lax.cond`` on the (device-invariant) round index, so no distance
+  work is duplicated. Bit-identity to serial and to the uni schedule is
+  property-tested at every mesh size; the round count and the
+  counter-directed permute pair are machine-checked from the lowered HLO
+  (``tests/test_hlo_overlap.py``, lint rule R4).
+
 Memory per device is O(m/P · d) for the rotating block plus the O(q_local · k)
 carry — the corpus-ring is the same skeleton ring-attention uses for long
 sequences, applied to a corpus axis (SURVEY.md §2a), and corpus capacity
@@ -68,6 +86,35 @@ from mpi_knn_tpu.parallel.partition import (
 from mpi_knn_tpu.utils.compat import axis_size, pcast_varying, shard_map
 
 
+def bidir_rounds(num_dev: int) -> tuple[int, int]:
+    """Round plan of the bidirectional schedule: ``(rounds, bwd_limit)``.
+
+    ``rounds = ⌊P/2⌋ + 1`` scan steps; the backward traveler merges on
+    rounds ``1 <= r < bwd_limit`` with ``bwd_limit = ⌈P/2⌉``. Outside that
+    window the round is degenerate and merges ONCE: at r=0 both travelers
+    are the own block, and for even P the antipodal block (r = P/2) arrives
+    from both directions simultaneously. Blocks merged per device:
+    ``1 + 2·(bwd_limit−1) + (1 if P even and P>1 else 0) = P`` — every
+    block exactly once, same as the P-round uni schedule."""
+    return num_dev // 2 + 1, -(-num_dev // 2)
+
+
+def blocking_undefined_on_mesh_error(mesh_axes) -> ValueError:
+    """The one wording for the 2-D-mesh × blocking-schedule hard error,
+    shared by both ring drivers and the trace-time backstop (VERDICT r5
+    weak #3: the blocking barrier can pin only the rotating block on a
+    multi-axis mesh — varying-axes typing, see the in-step note — so
+    'blocking' there would silently run the overlap schedule)."""
+    return ValueError(
+        "the blocking schedule (backend='ring' / overlap=False) is "
+        f"undefined on a multi-axis mesh (axes {tuple(mesh_axes)}): the "
+        "optimization barrier can pin only the rotating block there, so "
+        "the requested compute-then-send sequencing would silently run as "
+        "the overlap schedule. The 1-D ring is the only defined blocking "
+        "A/B object — use backend='ring-overlap' with --dp, or drop --dp."
+    )
+
+
 def _ring_knn_local(
     queries: jax.Array,  # (q_local, d) this device's query rows
     query_ids: jax.Array,  # (q_local,)
@@ -84,6 +131,11 @@ def _ring_knn_local(
     rotate: bool = True,  # single-round only: skip the ppermute on the last
     # round (the scan path gets this for free via dead-code elimination; a
     # live jit output would actually pay the ICI transfer)
+    block_bwd=None,  # bidir single-round only: the backward traveler
+    block_bwd_ids=None,
+    merge_bwd: bool = False,  # bidir single-round only: merge the backward
+    # traveler too (False on the degenerate rounds — r=0 and, for even P,
+    # the antipodal round)
 ):
     """Per-device body under shard_map: rotate corpus blocks around the ring,
     merging each into the local top-k carry.
@@ -92,20 +144,37 @@ def _ring_knn_local(
     ``lax.map`` over q_tile rows, the incoming block via ``lax.scan`` over
     c_tile rows — so device memory stays O(q_tile·c_tile + q_local·k + b·d)
     regardless of shard size, same as the serial backend's streaming.
+    ``cfg.ring_schedule="bidir"`` adds a second resident block (the
+    backward traveler) — still O(b·d), now ×2.
 
     With ``single_round=True`` (the resumable driver,
-    backends.ring_resumable) exactly one round runs and the rotated block is
-    returned alongside the merged carry, so the host owns the round cursor."""
+    backends.ring_resumable) exactly one round runs and the rotated block(s)
+    are returned alongside the merged carry, so the host owns the round
+    cursor."""
     num_dev = axis_size(axis)
+    bidir = cfg.ring_schedule == "bidir"
     # send to the next rank, wrap at the end — the reference's ring direction
-    # (rank -> rank+1, mpi-knn-parallel_blocking.c:131)
+    # (rank -> rank+1, mpi-knn-parallel_blocking.c:131); bidir adds the
+    # counter-rotating permute so both ICI link directions carry a block
     perm = [(i, (i + 1) % num_dev) for i in range(num_dev)]
+    perm_bwd = [(i, (i - 1) % num_dev) for i in range(num_dev)]
+
+    if not overlap and set(vary_axes or (axis,)) != {axis}:
+        # trace-time backstop for the wrapper-level check: on a multi-axis
+        # mesh the barrier below could pin only the block (an
+        # optimization_barrier unifies its outputs' varying sets, and this
+        # JAX has no varying->invarying pcast for the carry), i.e. the
+        # blocking schedule would silently BE the overlap schedule. Refuse
+        # rather than mislabel — tests/test_mesh2d.py asserts this.
+        raise blocking_undefined_on_mesh_error(vary_axes)
 
     if cfg.ring_transfer_dtype is not None:
         # circulate the block at the transfer dtype (bf16 halves the bytes
         # every ppermute moves over ICI); cast ONCE here — rounding does not
         # compound per hop — and upcast per round inside compute()
         block = block.astype(jnp.dtype(cfg.ring_transfer_dtype))
+        if block_bwd is not None:
+            block_bwd = block_bwd.astype(jnp.dtype(cfg.ring_transfer_dtype))
 
     q_local, dim = queries.shape
     b = block.shape[0]
@@ -169,30 +238,94 @@ def _ring_knn_local(
             # data dependence from the compute to the permute, and XLA may
             # schedule them concurrently — i.e. "blocking" would silently be
             # the overlap schedule (caught by tests/test_hlo_overlap.py,
-            # which found exactly that bug in the pre-r5 code).
+            # which found exactly that bug in the pre-r5 code). On a
+            # multi-axis mesh this threading is type-impossible (the raise
+            # above), so reaching here means the 1-D ring.
             cd, ci = compute(blk, blk_ids, cd, ci)
-            if set(vary_axes or (axis,)) == {axis}:
-                blk, blk_ids, cd, ci = jax.lax.optimization_barrier(
-                    (blk, blk_ids, cd, ci)
-                )
-            else:
-                # Multi-axis mesh: the carry varies over every mesh axis
-                # and an optimization_barrier unifies its outputs' varying
-                # sets, so threading the carry would make the block
-                # dp-varying — an invalid type for the scan carry and for
-                # the resumable driver's P(ring) out_spec (this JAX has no
-                # varying->invarying pcast). The barrier then pins only the
-                # block: results stay bit-identical, but compute->permute
-                # sequencing is NOT enforced here. The blocking schedule as
-                # a reference-parity/A-B object is defined on the 1-D ring
-                # (scripts/ring_ab.py, tests/test_hlo_overlap.py), which is
-                # the layout the reference implements.
-                blk, blk_ids = jax.lax.optimization_barrier((blk, blk_ids))
+            blk, blk_ids, cd, ci = jax.lax.optimization_barrier(
+                (blk, blk_ids, cd, ci)
+            )
             nxt = jax.lax.ppermute(blk, axis, perm)
             nxt_ids = jax.lax.ppermute(blk_ids, axis, perm)
         return (nxt, nxt_ids, cd, ci), None
 
+    rounds, bwd_limit = bidir_rounds(num_dev)
+
+    def bidir_step(state, r):
+        """One full-duplex round: the forward traveler (block i−r) always
+        merges; the backward traveler (block i+r) merges only on the
+        non-degenerate rounds (``lax.cond`` on the device-invariant round
+        index, so degenerate rounds pay ONE block's distance work, not a
+        masked two). Both permutes are issued every round — the pipeline
+        must keep both travelers moving even when one of them is not merged
+        this round."""
+        fblk, fids, bblk, bids, cd, ci = state
+        do_bwd = jnp.logical_and(r >= 1, r < bwd_limit)
+
+        def merge_bwd_traveler(cd, ci):
+            return compute(bblk, bids, cd, ci)
+
+        def skip(cd, ci):
+            return cd, ci
+
+        def merge(cd, ci):
+            # the forward traveler merges unconditionally — only the
+            # backward merge is round-dependent, so the heavy per-tile
+            # reduction is traced once per branch role, not duplicated
+            # across both cond branches
+            cd, ci = compute(fblk, fids, cd, ci)
+            return jax.lax.cond(do_bwd, merge_bwd_traveler, skip, cd, ci)
+
+        if overlap:
+            # all four permutes depend only on the incoming blocks; the two
+            # directions ride the two halves of each full-duplex ICI link
+            nfb = jax.lax.ppermute(fblk, axis, perm)
+            nfi = jax.lax.ppermute(fids, axis, perm)
+            nbb = jax.lax.ppermute(bblk, axis, perm_bwd)
+            nbi = jax.lax.ppermute(bids, axis, perm_bwd)
+            cd, ci = merge(cd, ci)
+        else:
+            cd, ci = merge(cd, ci)
+            fblk, fids, bblk, bids, cd, ci = jax.lax.optimization_barrier(
+                (fblk, fids, bblk, bids, cd, ci)
+            )
+            nfb = jax.lax.ppermute(fblk, axis, perm)
+            nfi = jax.lax.ppermute(fids, axis, perm)
+            nbb = jax.lax.ppermute(bblk, axis, perm_bwd)
+            nbi = jax.lax.ppermute(bids, axis, perm_bwd)
+        return (nfb, nfi, nbb, nbi, cd, ci), None
+
     if single_round:
+        if bidir:
+            if block_bwd is None or block_bwd_ids is None:
+                raise ValueError(
+                    "bidir single-round needs the backward traveler "
+                    "(block_bwd/block_bwd_ids)"
+                )
+            carry_d, carry_i = compute(block, block_ids, carry_d, carry_i)
+            if merge_bwd:
+                carry_d, carry_i = compute(
+                    block_bwd, block_bwd_ids, carry_d, carry_i
+                )
+            if rotate:
+                if not overlap:
+                    (block, block_ids, block_bwd, block_bwd_ids,
+                     carry_d, carry_i) = jax.lax.optimization_barrier(
+                        (block, block_ids, block_bwd, block_bwd_ids,
+                         carry_d, carry_i)
+                    )
+                nfb = jax.lax.ppermute(block, axis, perm)
+                nfi = jax.lax.ppermute(block_ids, axis, perm)
+                nbb = jax.lax.ppermute(block_bwd, axis, perm_bwd)
+                nbi = jax.lax.ppermute(block_bwd_ids, axis, perm_bwd)
+            else:
+                nfb, nfi = block, block_ids
+                nbb, nbi = block_bwd, block_bwd_ids
+            return (
+                nfb, nfi, nbb, nbi,
+                carry_d.reshape(q_local, cfg.k),
+                carry_i.reshape(q_local, cfg.k),
+            )
         if rotate:
             (nxt, nxt_ids, carry_d, carry_i), _ = step(
                 (block, block_ids, carry_d, carry_i), None
@@ -206,6 +339,19 @@ def _ring_knn_local(
             carry_d.reshape(q_local, cfg.k),
             carry_i.reshape(q_local, cfg.k),
         )
+
+    if bidir:
+        # ⌊P/2⌋+1 steps, both travelers starting as the own block. The last
+        # step's permutes are unused; XLA dead-code-eliminates them. The
+        # round index rides as the scan xs so the degenerate-round cond is
+        # part of the one compiled step body (the HLO scan trip count IS
+        # the round count — machine-checked in tests/test_hlo_overlap.py).
+        (_, _, _, _, carry_d, carry_i), _ = jax.lax.scan(
+            bidir_step,
+            (block, block_ids, block, block_ids, carry_d, carry_i),
+            jnp.arange(rounds),
+        )
+        return carry_d.reshape(q_local, cfg.k), carry_i.reshape(q_local, cfg.k)
 
     # P steps: own block once, then each of the P-1 received blocks — the
     # correct rotation the reference missed (SURVEY.md Q1). The final
@@ -315,6 +461,11 @@ def all_knn_ring(
     if mesh is None:
         mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
     q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
+    if not overlap and q_axis is not None:
+        # VERDICT r5 weak #3: on a dp×ring mesh the blocking barrier can pin
+        # only the block, so "blocking" would silently run the overlap
+        # schedule — a hard error, not a silent mislabel (see DESIGN.md §3)
+        raise blocking_undefined_on_mesh_error(mesh.axis_names)
 
     m, dim = corpus.shape
     nq = queries.shape[0]

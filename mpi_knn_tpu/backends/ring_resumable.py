@@ -11,7 +11,13 @@ pure function from (block, carry) to (next block, merged carry); the host
 loop owns the round cursor. A checkpoint is just (carry, rounds_done,
 fingerprint): the rotating block needs no saving because after r rounds
 device i holds corpus block (i − r) mod P — reconstructed on resume by
-rolling the padded corpus r blocks forward before sharding.
+rolling the padded corpus r blocks forward before sharding. Under
+``cfg.ring_schedule="bidir"`` the same single cursor reconstructs BOTH
+resident travelers (forward at i−r, backward at i+r: the corpus rolled r
+blocks each way), the loop runs ⌊P/2⌋+1 rounds instead of P, and the
+schedule is folded into the checkpoint fingerprint so uni and bidir
+carries — whose rounds_done mean different merged-block prefixes — can
+never cross-resume.
 
 ``stop_after_rounds`` is the fault-injection hook (SURVEY.md §6 "failure
 detection / fault injection"): tests kill the run at an arbitrary round and
@@ -38,6 +44,8 @@ from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.backends.ring import (
     _query_spec,
     _ring_knn_local,
+    bidir_rounds,
+    blocking_undefined_on_mesh_error,
     parse_ring_mesh,
     ring_tiles,
 )
@@ -116,6 +124,70 @@ def _ring_one_round(
     return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "overlap", "mesh", "axis", "q_tile", "c_tile", "q_axis",
+        "rotate", "merge_bwd",
+    ),
+)
+def _ring_one_round_bidir(
+    queries,
+    query_ids,
+    fblock,
+    fblock_ids,
+    bblock,
+    bblock_ids,
+    carry_d,
+    carry_i,
+    cfg,
+    overlap,
+    mesh,
+    axis,
+    q_tile,
+    c_tile,
+    q_axis=None,
+    rotate=True,
+    merge_bwd=False,
+):
+    """One bidirectional ring round: merge the forward traveler (block
+    i−r), merge the backward traveler (block i+r) unless the round is
+    degenerate (``merge_bwd=False``: round 0, and the antipodal round at
+    even P), then rotate both travelers one hop in opposite directions.
+    ``merge_bwd`` is static — the host knows the round plan, so the
+    degenerate rounds compile to genuinely single-merge programs rather
+    than masked double merges."""
+
+    def body(q, qid, fb, fids, bb, bids, cd, ci):
+        one = functools.partial(
+            _ring_knn_local,
+            cfg=cfg,
+            overlap=overlap,
+            axis=axis,
+            q_tile=q_tile,
+            c_tile=c_tile,
+            vary_axes=tuple(mesh.axis_names),
+            single_round=True,
+            carry_in=(cd, ci),
+            rotate=rotate,
+            merge_bwd=merge_bwd,
+        )
+        return one(q, qid, fb, fids, block_bwd=bb, block_bwd_ids=bids)
+
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qspec, qspec, cspec, cspec, cspec, cspec, qspec, qspec),
+        out_specs=(cspec, cspec, cspec, cspec, qspec, qspec),
+    )
+    return fn(
+        queries, query_ids, fblock, fblock_ids, bblock, bblock_ids,
+        carry_d, carry_i,
+    )
+
+
 def all_knn_ring_resumable(
     corpus,
     queries,
@@ -137,6 +209,17 @@ def all_knn_ring_resumable(
     if mesh is None:
         mesh = make_ring_mesh(cfg.num_devices, axis_name=cfg.mesh_axis)
     q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
+    if not overlap and q_axis is not None:
+        # same hard error as the scan-based driver (VERDICT r5 weak #3):
+        # blocking on a dp×ring mesh would silently run the overlap schedule
+        raise blocking_undefined_on_mesh_error(mesh.axis_names)
+    bidir = cfg.ring_schedule == "bidir"
+    # bidir: ⌊P/2⌋+1 host rounds; after r of them device i holds the
+    # forward traveler (i−r) AND the backward traveler (i+r) — one cursor,
+    # two reconstructible block positions
+    rounds_total, bwd_limit = (
+        bidir_rounds(ring_n) if bidir else (ring_n, 0)
+    )
 
     corpus = corpus if isinstance(corpus, jax.Array) else np.asarray(corpus)
     all_pairs = queries is corpus
@@ -145,9 +228,14 @@ def all_knn_ring_resumable(
     # changes block layout, so a carry from another mesh must not resume).
     # fingerprint() samples the WHOLE array stridedly (device-side for jax
     # arrays), so content changes anywhere in the corpus invalidate resume.
+    # The ring schedule is part of cfg (hashed by fingerprint()) AND spelled
+    # out here: a uni carry means "blocks 0..r−1 of the uni order merged", a
+    # bidir carry means "the two-cursor prefix merged" — the same
+    # rounds_done under the other schedule would silently skip/duplicate
+    # blocks, so the two must never cross-resume.
     fp = (
         fingerprint(corpus, queries, cfg)
-        + f":ring{ring_n}x{dp}:{int(overlap)}"
+        + f":ring{ring_n}x{dp}:{int(overlap)}:{cfg.ring_schedule}"
     )
     if cfg.center and cfg.metric == "l2":
         # centering accumulates the corpus mean in f32 on the device path
@@ -216,26 +304,36 @@ def all_knn_ring_resumable(
                 carry_i = jnp.asarray(state.carry_i)
         if start_round:
             log.info("resuming ring at round %d/%d from %s",
-                     start_round, ring_n, checkpoint_dir)
+                     start_round, rounds_total, checkpoint_dir)
 
     # after r rounds device i holds block (i − r) mod ring_n: roll the padded
     # corpus r blocks forward so sharding lands blocks correctly on resume.
+    # The bidir schedule's backward traveler sits at (i + r) — the SAME
+    # cursor, rolled the other way — so a one-integer checkpoint still
+    # reconstructs both resident blocks exactly.
     # Host inputs are rolled in numpy BEFORE the transfer (no extra device
     # copy); a device-resident corpus pays one transient on-device duplicate
     # (jnp.roll), acceptable because such a corpus already fits one device.
     shift = start_round * (c_pad // ring_n)
+
+    def _rolled(arr, s):
+        """Padded corpus (or ids) rolled s rows forward, residency-aware."""
+        if isinstance(arr, jax.Array):
+            out = pad_rows_any(arr, c_pad, dtype=dtype)
+            return jnp.roll(out, s, axis=0) if s else out
+        out = pad_rows(np.asarray(arr), c_pad)
+        if s:
+            out = np.roll(out, s, axis=0)
+        return jnp.asarray(out, dtype=dtype)
+
     corpus_ids_np = make_global_ids(m, c_pad)
     corpus_ids = jnp.asarray(np.roll(corpus_ids_np, shift) if shift else
                              corpus_ids_np)
-    if isinstance(corpus, jax.Array):
-        corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
-        if shift:
-            corpus_p = jnp.roll(corpus_p, shift, axis=0)
-    else:
-        cp = pad_rows(np.asarray(corpus), c_pad)
-        if shift:
-            cp = np.roll(cp, shift, axis=0)
-        corpus_p = jnp.asarray(cp, dtype=dtype)
+    corpus_p = _rolled(corpus, shift)
+    if bidir:
+        bwd_ids = jnp.asarray(np.roll(corpus_ids_np, -shift) if shift else
+                              corpus_ids_np)
+        bwd_p = _rolled(corpus, -shift) if shift else corpus_p
     queries_p = pad_rows_any(queries, q_pad, dtype=dtype)
     qids_p = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32)
 
@@ -249,36 +347,65 @@ def all_knn_ring_resumable(
         # the f32 corpus and re-casts here, so the values match a
         # never-interrupted run exactly (the cast is deterministic).
         corpus_p = corpus_p.astype(jnp.dtype(cfg.ring_transfer_dtype))
+        if bidir:
+            bwd_p = bwd_p.astype(jnp.dtype(cfg.ring_transfer_dtype))
     block = jax.device_put(corpus_p, c_sharding)
     block_ids = jax.device_put(corpus_ids, c_sharding)
+    if bidir:
+        block_b = jax.device_put(bwd_p, c_sharding)
+        block_b_ids = jax.device_put(bwd_ids, c_sharding)
     queries_p = jax.device_put(queries_p, q_sharding)
     qids_p = jax.device_put(qids_p, q_sharding)
     carry_d = jax.device_put(carry_d, q_sharding)
     carry_i = jax.device_put(carry_i, q_sharding)
 
-    total = ring_n if stop_after_rounds is None else min(
-        ring_n, start_round + stop_after_rounds
+    total = rounds_total if stop_after_rounds is None else min(
+        rounds_total, start_round + stop_after_rounds
     )
     for r in range(start_round, total):
-        block, block_ids, carry_d, carry_i = _ring_one_round(
-            queries_p,
-            qids_p,
-            block,
-            block_ids,
-            carry_d,
-            carry_i,
-            cfg,
-            overlap,
-            mesh,
-            axis,
-            q_tile,
-            c_tile,
-            q_axis=q_axis,
-            rotate=(r + 1 < ring_n),
-        )
+        if bidir:
+            (block, block_ids, block_b, block_b_ids,
+             carry_d, carry_i) = _ring_one_round_bidir(
+                queries_p,
+                qids_p,
+                block,
+                block_ids,
+                block_b,
+                block_b_ids,
+                carry_d,
+                carry_i,
+                cfg,
+                overlap,
+                mesh,
+                axis,
+                q_tile,
+                c_tile,
+                q_axis=q_axis,
+                rotate=(r + 1 < rounds_total),
+                # degenerate rounds (r=0; the antipodal round at even P)
+                # merge the forward traveler only — see ring.bidir_rounds
+                merge_bwd=(1 <= r < bwd_limit),
+            )
+        else:
+            block, block_ids, carry_d, carry_i = _ring_one_round(
+                queries_p,
+                qids_p,
+                block,
+                block_ids,
+                carry_d,
+                carry_i,
+                cfg,
+                overlap,
+                mesh,
+                axis,
+                q_tile,
+                c_tile,
+                q_axis=q_axis,
+                rotate=(r + 1 < rounds_total),
+            )
         done = r + 1
         if checkpoint_dir is not None and (
-            done % save_every == 0 or done == ring_n
+            done % save_every == 0 or done == rounds_total
         ):
             carry_d.block_until_ready()
             # multi-host: the carry spans processes; allgather the full array
@@ -295,8 +422,8 @@ def all_knn_ring_resumable(
                         fingerprint=fp,
                     ),
                 )
-        log.debug("ring round %d/%d done", done, ring_n)
+        log.debug("ring round %d/%d done", done, rounds_total)
         if progress_cb is not None:
-            progress_cb(done, ring_n)
+            progress_cb(done, rounds_total)
 
     return carry_d[:nq], carry_i[:nq]

@@ -17,6 +17,7 @@ METRICS = ("l2", "cosine")
 TOPK_METHODS = ("exact", "approx", "approx-rerank", "block", "bf16")
 PRECISION_POLICIES = ("exact", "mixed")
 MERGE_SCHEDULES = ("stream", "twolevel")
+RING_SCHEDULES = ("uni", "bidir")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
 
@@ -128,6 +129,25 @@ class KNNConfig:
     # it costs about what DEFAULT matmul precision costs (~0.3% recall@10,
     # BASELINE.md) — the recall gate measures it either way.
     ring_transfer_dtype: Optional[str] = None
+    # rotation schedule of the ring backends:
+    # "uni"   — the reference's one-directional ring (rank → rank+1,
+    #           mpi-knn-parallel_blocking.c:131): P rounds, each moving every
+    #           block one hop, using HALF of each full-duplex ICI link.
+    # "bidir" — full-duplex: every block circulates in BOTH torus directions
+    #           at once (a +1 and a −1 ppermute issued in the same scan
+    #           step), so at round r a device holds blocks i−r and i+r and
+    #           merges both into its carry. Rounds drop from P to ⌊P/2⌋+1;
+    #           total block-hops stay ~P·(P−1) but run concurrently over the
+    #           two link directions, halving the exposed communication
+    #           critical path (the EQuARX bidirectional-ring trick,
+    #           PAPERS.md). Degenerate rounds merge ONCE: round 0 both
+    #           travelers are the own block, and at even P the antipodal
+    #           block arrives from both sides on the final round. Results
+    #           are bit-identical to "uni" and to serial (property-tested);
+    #           composes with overlap, ring_transfer_dtype, and
+    #           precision_policy because the per-round block merge is the
+    #           same shared tile reduction.
+    ring_schedule: str = "uni"
     # pallas backend kernel shape: "tiles" = per-(q,c)-tile local top-k +
     # one XLA cross-tile merge (honors topk_method there); "sweep" = whole
     # corpus swept on the minor grid axis with the carry in VMEM scratch,
@@ -166,6 +186,11 @@ class KNNConfig:
             raise ValueError(
                 "ring_transfer_dtype must be None, 'bfloat16' or 'float32', "
                 f"got {self.ring_transfer_dtype!r}"
+            )
+        if self.ring_schedule not in RING_SCHEDULES:
+            raise ValueError(
+                f"ring_schedule must be one of {RING_SCHEDULES}, got "
+                f"{self.ring_schedule!r}"
             )
         if self.merge_schedule not in MERGE_SCHEDULES:
             raise ValueError(

@@ -72,6 +72,9 @@ class Instruction:
     operands: list[str]  # names used inside the operand parens (data)
     called: list[str]  # computations referenced from attributes
     attrs: str  # raw attribute text (custom_call_target etc.)
+    operand_text: str = ""  # raw operand parens content — the only place a
+    # literal payload survives (e.g. ``constant(5)``: no operand NAMES, but
+    # the scan-trip-count reader needs the 5)
     controls: list[str] = field(default_factory=list)  # control-predecessors
     type_str: str = ""  # raw result type text, e.g. "f32[4,8]{1,0}"
     param_index: int | None = None
@@ -176,6 +179,7 @@ def parse_hlo(text: str) -> HloModule:
             name=name,
             opcode=opcode,
             operands=_names(operand_text),
+            operand_text=operand_text,
             controls=control,
             called=_CALLED_RE.findall(attrs)
             + [

@@ -17,6 +17,12 @@ Two families, one JSON artifact:
   precision rows time the distance tile alone.
 - ``smallest_k`` at each method (``exact``/``approx``/``approx-rerank``/
   ``block``/``bf16``) over a fixed pre-computed distance tile.
+- ``ring_allknn``: the ring-schedule 2×2 (uni vs bidir × blocking/overlap)
+  end to end on a virtual CPU mesh (``--ring-devices``, default 8; 0
+  disables the rows AND the CPU-platform forcing they require — pass 0 to
+  bench a real accelerator's per-op rows). On CPU the cells measure
+  schedule mechanics (collectives are memcpys), pinning the per-PR
+  trajectory; on a chip the same rows measure real ICI.
 
 CPU numbers say nothing absolute about the TPU — what they pin is the
 RELATIVE trajectory per op across PRs, on the platform CI always has
@@ -66,7 +72,19 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=784)
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--ring-devices", type=int, default=8,
+                    help="virtual CPU mesh size for the ring-schedule rows; "
+                    "0 disables them (and the CPU forcing they need)")
     args = ap.parse_args(argv)
+
+    if args.ring_devices:
+        # the ring rows need a multi-device mesh, which on a CPU host means
+        # forcing the virtual-device platform BEFORE jax initializes; this
+        # pins every row to CPU — deliberate for the trajectory artifact,
+        # opt out with --ring-devices 0 on a real accelerator
+        from mpi_knn_tpu.utils.platform import force_platform
+
+        force_platform("cpu", n_devices=args.ring_devices)
 
     import jax
     import jax.numpy as jnp
@@ -168,6 +186,33 @@ def main(argv=None) -> int:
             "smallest_k", method,
             _time(lambda: select(dist_fixed, c_ids, method=method), reps),
         )
+
+    # -- ring schedule 2×2: uni vs bidir × blocking/overlap ---------------
+    if args.ring_devices:
+        from mpi_knn_tpu import all_knn
+        from mpi_knn_tpu.parallel.mesh import make_ring_mesh
+
+        mesh = make_ring_mesh(args.ring_devices)
+        # query subset over the full corpus: enough work per round for the
+        # schedule difference to register, small enough that four cells add
+        # seconds, not minutes, to the artifact
+        n_ring_q = min(256, c)
+        Qr = np.asarray(X[:n_ring_q])
+        for sched in ("uni", "bidir"):
+            for name, backend in (("blocking", "ring"),
+                                  ("overlap", "ring-overlap")):
+                rcfg = KNNConfig(k=k, backend=backend, ring_schedule=sched,
+                                 query_tile=min(128, n_ring_q),
+                                 corpus_tile=min(1024, c))
+                record(
+                    "ring_allknn", f"{sched}-{name}",
+                    _time(
+                        lambda: all_knn(
+                            np.asarray(X), queries=Qr, config=rcfg, mesh=mesh
+                        ).dists,
+                        reps,
+                    ),
+                )
 
     doc = {
         "schema": "bench_ops.v1",

@@ -50,7 +50,11 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # the default sweep is the full backend × metric × dtype matrix PLUS the
 # precision_policy=mixed cells for every backend × metric — R3 certifies
 # the compress-and-rerank dot contract there (exactly one DEFAULT compress
-# dot per tile computation, rerank at HIGHEST); any finding fails the gate
+# dot per tile computation, rerank at HIGHEST) — PLUS the
+# ring_schedule=bidir cells for both ring backends × metric × both
+# policies, where R4 certifies the full-duplex accounting (exactly 2
+# counter-directed collective-permutes per torus direction; wrong-direction
+# or missing permutes are findings); any finding fails the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
 
 note "tier-1 pytest (the ROADMAP.md gate)"

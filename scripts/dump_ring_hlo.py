@@ -3,12 +3,21 @@
 Compiles BOTH production ring drivers — ``_ring_one_round`` (the resumable
 single-step jit) and ``_ring_knn_sharded`` (the headline ``lax.scan``
 driver; its permute lives inside the scan's while body) — for both
-schedules on the virtual 8-device CPU mesh, and writes eight HLO dumps
-plus a machine-checked verdict:
+sequencing variants AND both rotation schedules (uni / bidir) on the
+virtual 8-device CPU mesh, and writes sixteen HLO dumps plus a
+machine-checked verdict:
 
     artifacts/hlo/ring_step_{overlap,blocking}.{before,after}_opt.hlo.txt
     artifacts/hlo/ring_scan_{overlap,blocking}.{before,after}_opt.hlo.txt
+    artifacts/hlo/ring_step_bidir_{overlap,blocking}.{before,after}_opt.hlo.txt
+    artifacts/hlo/ring_scan_bidir_{overlap,blocking}.{before,after}_opt.hlo.txt
     artifacts/hlo/overlap_verdict.json
+
+The bidir dumps additionally certify the full-duplex claims from the HLO
+itself (``verdict["bidir"]``): exactly 2 collective-permutes per torus
+direction with counter-directed ``source_target_pairs``, and a scan trip
+count of ⌊P/2⌋+1 (5 on the 8-mesh) read from the rotation while-loop's
+condition — the round count is machine-checked, not trusted from Python.
 
 The structural property (checked by ``mpi_knn_tpu.analysis.rules`` over
 the ``mpi_knn_tpu.utils.hlo_graph`` def-use graph and asserted in
@@ -58,29 +67,63 @@ def main(out_dir: pathlib.Path) -> int:
     from mpi_knn_tpu.analysis.lowering import lower_ring_driver
     from mpi_knn_tpu.analysis.rules import (
         permute_dependence_report,
+        permute_direction_census,
         property_holds,
+        ring_scan_trip_counts,
     )
+    from mpi_knn_tpu.utils.hlo_graph import parse_hlo
 
+    RING_N = 8  # the virtual mesh size forced above
     out_dir.mkdir(parents=True, exist_ok=True)
     # artifact file names: the single-round driver keeps its original
-    # "ring_step_" prefix; the scan driver dumps as "ring_scan_"
+    # "ring_step_" prefix; the scan driver dumps as "ring_scan_"; the bidir
+    # schedule adds a "_bidir" infix
     prefix = {"one_round": "ring_step", "scan": "ring_scan"}
-    verdict: dict = {"source": "scripts/dump_ring_hlo.py", "drivers": {}}
+    verdict: dict = {
+        "source": "scripts/dump_ring_hlo.py",
+        "drivers": {},
+        "bidir": {"expected_rounds": RING_N // 2 + 1, "cells": {}},
+    }
+    bidir_ok = True
     for driver in ("one_round", "scan"):
-        variants: dict = {}
-        for variant in ("overlap", "blocking"):
-            texts = lower_ring_driver(driver, variant)
-            stages = {}
-            for stage, text in texts.items():
-                dst = out_dir / f"{prefix[driver]}_{variant}.{stage}.hlo.txt"
-                dst.write_text(text)
-                stages[stage] = permute_dependence_report(text)
-            variants[variant] = stages
-        verdict["drivers"][driver] = variants
+        for schedule in ("uni", "bidir"):
+            tag = prefix[driver] + ("" if schedule == "uni" else "_bidir")
+            key = driver if schedule == "uni" else f"{driver}_bidir"
+            variants: dict = {}
+            for variant in ("overlap", "blocking"):
+                texts = lower_ring_driver(driver, variant, schedule=schedule)
+                stages = {}
+                for stage, text in texts.items():
+                    dst = out_dir / f"{tag}_{variant}.{stage}.hlo.txt"
+                    dst.write_text(text)
+                    stages[stage] = permute_dependence_report(text)
+                variants[variant] = stages
+                if schedule == "bidir":
+                    # full-duplex accounting, read from the module XLA
+                    # receives: 2 counter-directed permutes per direction,
+                    # and (scan driver) the ⌊P/2⌋+1 trip count
+                    mod = parse_hlo(texts["before_opt"])
+                    census = permute_direction_census(mod, RING_N)
+                    cell = {"permute_census": census}
+                    cell_ok = (
+                        census["fwd"] == 2
+                        and census["bwd"] == 2
+                        and not census["other"]
+                    )
+                    if driver == "scan":
+                        trips = ring_scan_trip_counts(mod)
+                        cell["scan_trip_counts"] = trips
+                        cell_ok = cell_ok and trips == [RING_N // 2 + 1]
+                    cell["ok"] = cell_ok
+                    bidir_ok = bidir_ok and cell_ok
+                    verdict["bidir"]["cells"][f"{driver}/{variant}"] = cell
+            verdict["drivers"][key] = variants
 
+    verdict["bidir"]["ok"] = bidir_ok
     # single shared definition — see analysis.rules.property_holds; the
-    # property must hold for BOTH production drivers
-    ok = all(
+    # sequencing property must hold for BOTH production drivers under BOTH
+    # rotation schedules, and the bidir accounting must check out
+    ok = bidir_ok and all(
         property_holds(variants) for variants in verdict["drivers"].values()
     )
     verdict["property_holds"] = ok

@@ -1,17 +1,27 @@
 """A/B benchmark: blocking-schedule ring vs overlapped ring (BASELINE.md
-configs "blocking ring" / "non-blocking (overlapped) 8-way ring").
+configs "blocking ring" / "non-blocking (overlapped) 8-way ring"), crossed
+with the rotation-schedule axis (uni vs bidir full-duplex counter-rotation,
+``cfg.ring_schedule``) — a 2×2 matrix per run.
 
-The reference shipped the same A/B as two whole programs and the B side
-never actually overlapped (MPI_Wait before compute — SURVEY.md Q7). Here
-both schedules share one implementation (backends/ring.py, overlap flag);
-this harness times them on identical data/mesh and reports the ratio, which
-on real multi-chip hardware quantifies how much ICI transfer hides under
-the distance matmul. On a CPU-simulated mesh the ratio is meaningless
-(collectives are memcpys) — the harness still runs for mechanics testing.
+The reference shipped the sequencing A/B as two whole programs and the B
+side never actually overlapped (MPI_Wait before compute — SURVEY.md Q7).
+Here all four cells share one implementation (backends/ring.py: overlap
+flag × ring_schedule); this harness times them on identical data/mesh and
+reports the ratios, which on real multi-chip hardware quantify (a) how much
+ICI transfer hides under the distance matmul and (b) how much of the
+remaining exposed communication the bidirectional schedule's halved
+critical path buys back. On a CPU-simulated mesh the ratios are meaningless
+(collectives are memcpys) — the harness still runs for mechanics testing
+and for the four-way bit-agreement check.
+
+``--dp`` builds a 2-D mesh, on which the blocking schedule is undefined
+(the barrier can pin only the block there — see DESIGN.md §3), so the A/B
+refuses it: the 1-D ring is the only defined A/B object.
 
 Usage:
     python scripts/ring_ab.py --m 60000 --d 784 --k 10 [--devices N]
-                              [--dp G] [--reps 3] [--json PATH]
+                              [--schedule uni|bidir|both] [--reps 3]
+                              [--json PATH]
 """
 
 from __future__ import annotations
@@ -35,6 +45,10 @@ def main(argv=None) -> int:
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--devices", type=int, default=None)
     ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--schedule", choices=["uni", "bidir", "both"],
+                    default="both",
+                    help="rotation schedule axis of the A/B matrix "
+                    "(default: both)")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--query-tile", type=int, default=1024)
     ap.add_argument("--corpus-tile", type=int, default=4096)
@@ -56,65 +70,89 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from mpi_knn_tpu import KNNConfig, all_knn
-    from mpi_knn_tpu.parallel.mesh import make_mesh2d, make_ring_mesh
+    from mpi_knn_tpu.parallel.mesh import make_ring_mesh
     from mpi_knn_tpu.utils.report import recall_at_k
     from mpi_knn_tpu.utils.timing import device_sync
 
     n_dev = args.devices or len(jax.devices())
     if args.dp > 1:
-        if n_dev % args.dp:
-            raise SystemExit(f"--dp {args.dp} must divide {n_dev}")
-        mesh = make_mesh2d(args.dp, n_dev // args.dp)
-    else:
-        mesh = make_ring_mesh(n_dev)
+        # the blocking A side is undefined on a 2-D mesh (DESIGN.md §3) —
+        # running only the B side would not be an A/B
+        raise SystemExit(
+            "--dp is not a valid A/B axis: the blocking schedule is "
+            "undefined on a dp×ring mesh (the barrier can pin only the "
+            "block there). The 1-D ring is the only defined A/B object."
+        )
+    mesh = make_ring_mesh(n_dev)
 
     rng = np.random.default_rng(0)
     X = rng.standard_normal((args.m, args.d)).astype(np.float32)
     Xd = jax.device_put(jnp.asarray(X))
     device_sync(Xd)
 
+    schedules = (
+        ("uni", "bidir") if args.schedule == "both" else (args.schedule,)
+    )
     results = {}
     ids = {}
-    for name, backend in (("blocking", "ring"), ("overlap", "ring-overlap")):
-        cfg = KNNConfig(
-            k=args.k,
-            backend=backend,
-            query_tile=args.query_tile,
-            corpus_tile=args.corpus_tile,
-        )
-        res = all_knn(Xd, config=cfg, mesh=mesh)  # compile + warm
-        device_sync(res.dists)
-        times = []
-        for _ in range(args.reps):
-            t0 = time.perf_counter()
-            res = all_knn(Xd, config=cfg, mesh=mesh)
-            device_sync(res.dists, res.ids)
-            times.append(time.perf_counter() - t0)
-        results[name] = min(times)
-        if args.profile_dir:
-            tdir = str(Path(args.profile_dir) / name)
-            with jax.profiler.trace(tdir):
+    for sched in schedules:
+        for name, backend in (("blocking", "ring"),
+                              ("overlap", "ring-overlap")):
+            cell = f"{sched}-{name}"
+            cfg = KNNConfig(
+                k=args.k,
+                backend=backend,
+                query_tile=args.query_tile,
+                corpus_tile=args.corpus_tile,
+                ring_schedule=sched,
+            )
+            res = all_knn(Xd, config=cfg, mesh=mesh)  # compile + warm
+            device_sync(res.dists)
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
                 res = all_knn(Xd, config=cfg, mesh=mesh)
                 device_sync(res.dists, res.ids)
-        # sample neighbor ids for the A==B sanity check (full fetch would be
-        # slow over tunneled transports)
-        sample = jnp.asarray(
-            np.linspace(0, args.m - 1, num=min(128, args.m), dtype=np.int64)
-        )
-        ids[name] = np.asarray(jax.device_get(res.ids[sample]))
+                times.append(time.perf_counter() - t0)
+            results[cell] = min(times)
+            if args.profile_dir:
+                tdir = str(Path(args.profile_dir) / cell)
+                with jax.profiler.trace(tdir):
+                    res = all_knn(Xd, config=cfg, mesh=mesh)
+                    device_sync(res.dists, res.ids)
+            # sample neighbor ids for the all-cells-agree sanity check (a
+            # full fetch would be slow over tunneled transports)
+            sample = jnp.asarray(
+                np.linspace(0, args.m - 1, num=min(128, args.m),
+                            dtype=np.int64)
+            )
+            ids[cell] = np.asarray(jax.device_get(res.ids[sample]))
 
-    same = recall_at_k(ids["overlap"], ids["blocking"])
+    ref_cell = next(iter(ids))
+    same = min(
+        recall_at_k(got, ids[ref_cell]) for got in ids.values()
+    )
     out = {
         "m": args.m,
         "d": args.d,
         "k": args.k,
         "mesh": list(np.asarray(mesh.devices).shape),
         "platform": jax.default_backend(),
-        "blocking_s": round(results["blocking"], 4),
-        "overlap_s": round(results["overlap"], 4),
-        "speedup_overlap": round(results["blocking"] / results["overlap"], 3),
+        "cells_s": {c: round(t, 4) for c, t in results.items()},
         "results_agree": round(float(same), 5),
     }
+    for sched in schedules:
+        if f"{sched}-blocking" in results:
+            out[f"speedup_overlap_{sched}"] = round(
+                results[f"{sched}-blocking"] / results[f"{sched}-overlap"], 3
+            )
+    if len(schedules) == 2:
+        # the headline of the schedule axis: exposed-communication critical
+        # path halves, so bidir/uni quantifies what that buys per variant
+        for name in ("blocking", "overlap"):
+            out[f"speedup_bidir_{name}"] = round(
+                results[f"uni-{name}"] / results[f"bidir-{name}"], 3
+            )
     print(json.dumps(out))
     if args.json:
         with open(args.json, "w") as f:

@@ -1,17 +1,21 @@
-"""Worker for the 2-process multi-host test (run via tests/test_multihost.py).
+"""Worker for the multi-process pod tests (run via tests/test_multihost.py).
 
-Each process joins a Gloo-backed 2-process CPU "pod" (4 virtual devices per
-process, 8 global) through the SAME code path a real multi-host TPU launch
-uses — ``init_multihost`` reading JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
-JAX_PROCESS_ID (``mpi_knn_tpu/parallel/distributed.py``) — and then drives
-the distributed ring with checkpoint/resume:
+Each process joins a Gloo-backed CPU "pod" — MH_LOCAL_DEVICES virtual
+devices per process, JAX_NUM_PROCESSES processes (2×4 and 4×2 in the
+shipped tests, 8 global devices either way) — through the SAME code path a
+real multi-host TPU launch uses — ``init_multihost`` reading
+JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID
+(``mpi_knn_tpu/parallel/distributed.py``) — and then drives the
+distributed ring with checkpoint/resume:
 
-1. ring all-kNN over the 8-device global mesh, killed after 2 of 8 rounds
-   (fault injection; process 0 writes the carry checkpoint);
-2. resume to completion. The checkpoint dir is PER-PROCESS (non-shared), so
-   process 1's local read finds nothing — the broadcast-from-process-0
-   agreement (ADVICE r1 fix) is what makes both processes enter the round
-   loop at round 2 together instead of hanging in mismatched collectives;
+1. ring all-kNN over the 8-device global mesh (rotation schedule from
+   MH_RING_SCHEDULE: uni or bidir), killed after 2 rounds (fault
+   injection; process 0 writes the carry checkpoint);
+2. resume to completion. The checkpoint dir is PER-PROCESS (non-shared),
+   so every non-zero process's local read finds nothing — the
+   broadcast-from-process-0 agreement (ADVICE r1 fix) is what makes all
+   processes enter the round loop at the same round together instead of
+   hanging in mismatched collectives;
 3. verify ids against a locally computed serial oracle (fetch_global
    exercises the process_allgather branch on the cross-process result).
 
@@ -27,7 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mpi_knn_tpu.utils.platform import force_platform  # noqa: E402
 
-force_platform("cpu", n_devices=4)
+_LOCAL_DEVICES = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
+force_platform("cpu", n_devices=_LOCAL_DEVICES)
 
 import numpy as np  # noqa: E402
 
@@ -37,22 +42,30 @@ def main() -> int:
 
     # env-var path: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     # JAX_PROCESS_ID are set by the spawning test
+    num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     info = init_multihost(timeout_seconds=60)
-    assert info["num_processes"] == 2, info
-    assert info["devices"] == 8, info
-    assert info["local_devices"] == 4, info
+    assert info["num_processes"] == num_processes, info
+    assert info["devices"] == num_processes * _LOCAL_DEVICES, info
+    assert info["local_devices"] == _LOCAL_DEVICES, info
 
     import jax
 
     from mpi_knn_tpu import KNNConfig, all_knn
+    from mpi_knn_tpu.backends.ring import bidir_rounds
     from mpi_knn_tpu.backends.ring_resumable import all_knn_ring_resumable
     from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 
     rng = np.random.default_rng(7)
     X = rng.standard_normal((64, 12)).astype(np.float32)
     qids = np.arange(len(X), dtype=np.int32)
-    cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8)
-    mesh = make_ring_mesh(8)
+    schedule = os.environ.get("MH_RING_SCHEDULE", "uni")
+    cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8,
+                    ring_schedule=schedule)
+    ring_n = info["devices"]
+    total_rounds = (
+        bidir_rounds(ring_n)[0] if schedule == "bidir" else ring_n
+    )
+    mesh = make_ring_mesh(ring_n)
 
     # per-process (NON-shared) checkpoint dir: only process 0's dir ever
     # gets the file, so resume agreement must come from the broadcast
@@ -77,9 +90,10 @@ def main() -> int:
         X, X, qids, cfg, mesh=mesh, checkpoint_dir=ck,
         progress_cb=lambda r, t: rounds2.append(r),
     )
-    # both processes must agree to RESUME at round 2 (process 1's own dir is
-    # empty — without the broadcast it would restart at 0 and desync)
-    assert rounds2 == [3, 4, 5, 6, 7, 8], rounds2
+    # ALL processes must agree to RESUME at round 2 (every non-zero
+    # process's own dir is empty — without the broadcast they would
+    # restart at 0 and desync)
+    assert rounds2 == list(range(3, total_rounds + 1)), rounds2
 
     ids = fetch_global(i)  # process_allgather branch: result spans processes
     dists = fetch_global(d)

@@ -321,7 +321,8 @@ def test_bench_driver_contract():
 
 
 def test_ring_ab_script():
-    """scripts/ring_ab.py runs both ring schedules and reports agreement."""
+    """scripts/ring_ab.py runs the full 2×2 A/B matrix (uni/bidir ×
+    blocking/overlap) and reports per-cell timings + four-way agreement."""
     r = subprocess.run(
         [sys.executable, "scripts/ring_ab.py", "--m", "256", "--d", "16",
          "--k", "3", "--platform", "cpu", "--reps", "1"],
@@ -331,7 +332,12 @@ def test_ring_ab_script():
     assert r.returncode == 0, r.stderr[-2000:]
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["results_agree"] == 1.0
-    assert out["blocking_s"] > 0 and out["overlap_s"] > 0
+    cells = {f"{s}-{v}" for s in ("uni", "bidir")
+             for v in ("blocking", "overlap")}
+    assert set(out["cells_s"]) == cells
+    assert all(t > 0 for t in out["cells_s"].values())
+    assert out["speedup_overlap_uni"] > 0
+    assert out["speedup_bidir_overlap"] > 0
 
 
 def test_save_neighbors_and_corrupt_checkpoint(tmp_path):

@@ -133,6 +133,102 @@ def test_r4_catches_injected_sharding_leak():
     assert strays, "all-gather leak not flagged"
 
 
+_BIDIR_TMPL = """\
+HloModule b, entry_computation_layout={(f32[4,8]{1,0})->f32[4,8]{1,0}}
+
+ENTRY %main.1 (a.1: f32[4,8]) -> f32[4,8] {
+  %a.1 = f32[4,8]{1,0} parameter(0)
+  %cp.1 = f32[4,8]{1,0} collective-permute(%a.1), channel_id=1, source_target_pairs=FWD
+  %cp.2 = f32[4,8]{1,0} collective-permute(%a.1), channel_id=2, source_target_pairs=FWD
+  %cp.3 = f32[4,8]{1,0} collective-permute(%a.1), channel_id=3, source_target_pairs=PAIRS3
+  %cp.4 = f32[4,8]{1,0} collective-permute(%a.1), channel_id=4, source_target_pairs=PAIRS4
+  ROOT %s.1 = f32[4,8]{1,0} add(%cp.1, %cp.3)
+}
+"""
+
+_FWD4 = "{{0,1},{1,2},{2,3},{3,0}}"
+_BWD4 = "{{0,3},{1,0},{2,1},{3,2}}"
+# neither rotation: 0 and 1 swapped pairwise, 2→3→2 — a "ring" nobody runs
+_WRONG4 = "{{0,1},{1,0},{2,3},{3,2}}"
+
+
+def _bidir_module(pairs3, pairs4):
+    return (
+        _BIDIR_TMPL.replace("FWD", _FWD4)
+        .replace("PAIRS3", pairs3)
+        .replace("PAIRS4", pairs4)
+    )
+
+
+def _bidir_ctx():
+    return _ctx(backend="ring", ring_n=4, expected_permutes=4,
+                ring_schedule="bidir")
+
+
+def test_r4_bidir_accounting_passes_the_correct_shape():
+    """2 forward + 2 backward counter-directed permutes — the compiled
+    shape of the full-duplex round — is clean."""
+    texts = {"before_opt": _bidir_module(_BWD4, _BWD4)}
+    findings, _ = engine.run_rules(texts, _bidir_ctx(), _rules("R4-collective"))
+    assert not findings, [f.message for f in findings]
+
+
+def test_r4_bidir_catches_missing_counter_directed_permute():
+    """All four permutes forward (the ids pair never counter-rotated — a
+    silent fallback to half-duplex) must be a finding."""
+    texts = {"before_opt": _bidir_module(_FWD4, _FWD4)}
+    findings, _ = engine.run_rules(texts, _bidir_ctx(), _rules("R4-collective"))
+    assert findings
+    assert any("half-duplex" in f.message for f in findings)
+
+
+def test_r4_bidir_catches_wrong_direction_permute():
+    """A permute whose source_target_pairs is neither ring rotation merges
+    blocks in an order the round plan does not account for — a finding."""
+    texts = {"before_opt": _bidir_module(_BWD4, _WRONG4)}
+    findings, _ = engine.run_rules(texts, _bidir_ctx(), _rules("R4-collective"))
+    assert any("neither the forward nor the backward" in f.message
+               for f in findings)
+
+
+def test_r4_bidir_catches_missing_permute_count():
+    """Only 2 permutes under a bidir context (one traveler never moves)."""
+    mod = "\n".join(
+        line for line in _bidir_module(_BWD4, _BWD4).splitlines()
+        if "cp.2" not in line and "cp.4" not in line
+    )
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _bidir_ctx(), _rules("R4-collective")
+    )
+    assert any("expected exactly 4" in f.message for f in findings)
+
+
+def test_r4_bidir_two_ring_checks_combined_count_only():
+    """On a 2-ring the forward and backward rotations coincide ({{0,1},
+    {1,0}}), so the census cannot split directions — R4 must accept a
+    correct 4-permute program there (the per-direction split false-failed
+    `lint --devices 2` before this regression test) and still flag a
+    missing permute via the combined count."""
+    two = "{{0,1},{1,0}}"
+    mod = (
+        _BIDIR_TMPL.replace("FWD", two)
+        .replace("PAIRS3", two)
+        .replace("PAIRS4", two)
+    )
+    ctx = _ctx(backend="ring", ring_n=2, expected_permutes=4,
+               ring_schedule="bidir")
+    findings, _ = engine.run_rules({"before_opt": mod}, ctx,
+                                   _rules("R4-collective"))
+    assert not findings, [f.message for f in findings]
+    # drop one permute: the combined count still catches it
+    short = "\n".join(
+        line for line in mod.splitlines() if "cp.4" not in line
+    )
+    findings, _ = engine.run_rules({"before_opt": short}, ctx,
+                                   _rules("R4-collective"))
+    assert findings
+
+
 def test_r4_flags_any_collective_in_single_device_backends():
     """The same leaked program judged as a serial lowering: ANY collective
     is a violation there."""

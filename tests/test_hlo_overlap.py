@@ -166,11 +166,112 @@ def _reports(root: pathlib.Path, prefix: str) -> dict:
 
 def test_committed_artifacts_hold_the_property():
     # both production drivers: the resumable single-round jit and the
-    # headline lax.scan driver (permute inside the scan's while body)
+    # headline lax.scan driver (permute inside the scan's while body) —
+    # under BOTH rotation schedules
     _assert_property(_reports(ART, "ring_step"))
     _assert_property(_reports(ART, "ring_scan"))
+    _assert_property(_reports(ART, "ring_step_bidir"))
+    _assert_property(_reports(ART, "ring_scan_bidir"))
     verdict = json.loads((ART / "overlap_verdict.json").read_text())
     assert verdict["property_holds"] is True
+    assert verdict["bidir"]["ok"] is True
+
+
+def test_bidir_round_count_and_permute_directions_from_hlo():
+    """The bidir schedule's two headline claims, read from the module XLA
+    receives rather than trusted from the Python that emitted it: the
+    rotation scan runs ⌊P/2⌋+1 trips (5 on the 8-mesh, vs 8 for uni), and
+    every round issues exactly 2 collective-permutes per torus direction
+    (block + ids), counter-directed source_target_pairs, nothing else."""
+    from mpi_knn_tpu.analysis.rules import (
+        permute_direction_census,
+        ring_scan_trip_counts,
+    )
+
+    for variant in ("overlap", "blocking"):
+        bid = parse_hlo(
+            (ART / f"ring_scan_bidir_{variant}.before_opt.hlo.txt")
+            .read_text()
+        )
+        assert ring_scan_trip_counts(bid) == [5], variant
+        assert permute_direction_census(bid, 8) == {
+            "fwd": 2, "bwd": 2, "other": []
+        }, variant
+        uni = parse_hlo(
+            (ART / f"ring_scan_{variant}.before_opt.hlo.txt").read_text()
+        )
+        assert ring_scan_trip_counts(uni) == [8], variant
+        assert permute_direction_census(uni, 8) == {
+            "fwd": 2, "bwd": 0, "other": []
+        }, variant
+        # the single-round (resumable) driver has no scan but must show the
+        # same per-round permute accounting
+        step = parse_hlo(
+            (ART / f"ring_step_bidir_{variant}.before_opt.hlo.txt")
+            .read_text()
+        )
+        assert permute_direction_census(step, 8) == {
+            "fwd": 2, "bwd": 2, "other": []
+        }, variant
+
+
+_TRIP_SYNTH = """\
+HloModule t, entry_computation_layout={(f32[4,8]{1,0})->f32[4,8]{1,0}}
+
+%tcond.1 (tc.1: (s32[], f32[4,8])) -> pred[] {
+  %tc.1 = (s32[], f32[4,8]{1,0}) parameter(0)
+  %i.1 = s32[] get-tuple-element(%tc.1), index=0
+  %n.1 = s32[] constant(5)
+  ROOT %lt.1 = pred[] compare(%i.1, %n.1), direction=LT
+}
+
+%tbody.1 (tb.1: (s32[], f32[4,8])) -> (s32[], f32[4,8]) {
+  %tb.1 = (s32[], f32[4,8]{1,0}) parameter(0)
+  %i.2 = s32[] get-tuple-element(%tb.1), index=0
+  %one.1 = s32[] constant(1)
+  %ip.1 = s32[] add(%i.2, %one.1)
+  %b.2 = f32[4,8]{1,0} get-tuple-element(%tb.1), index=1
+  %cp.5 = f32[4,8]{1,0} collective-permute(%b.2), channel_id=5, source_target_pairs={{0,1},{1,0}}
+  ROOT %rt.2 = (s32[], f32[4,8]{1,0}) tuple(%ip.1, %cp.5)
+}
+
+%ncond.1 (nc.1: (s32[], f32[4,8])) -> pred[] {
+  %nc.1 = (s32[], f32[4,8]{1,0}) parameter(0)
+  %i.3 = s32[] get-tuple-element(%nc.1), index=0
+  %n.2 = s32[] constant(7)
+  ROOT %lt.2 = pred[] compare(%i.3, %n.2), direction=LT
+}
+
+%nbody.1 (nb.1: (s32[], f32[4,8])) -> (s32[], f32[4,8]) {
+  %nb.1 = (s32[], f32[4,8]{1,0}) parameter(0)
+  %i.4 = s32[] get-tuple-element(%nb.1), index=0
+  %one.2 = s32[] constant(1)
+  %ip.2 = s32[] add(%i.4, %one.2)
+  %b.3 = f32[4,8]{1,0} get-tuple-element(%nb.1), index=1
+  ROOT %rt.3 = (s32[], f32[4,8]{1,0}) tuple(%ip.2, %b.3)
+}
+
+ENTRY %main.4 (a.3: f32[4,8]) -> f32[4,8] {
+  %a.3 = f32[4,8]{1,0} parameter(0)
+  %z.2 = s32[] constant(0)
+  %wt.2 = (s32[], f32[4,8]{1,0}) tuple(%z.2, %a.3)
+  %w.2 = (s32[], f32[4,8]{1,0}) while(%wt.2), condition=%tcond.1, body=%tbody.1
+  %g.2 = f32[4,8]{1,0} get-tuple-element(%w.2), index=1
+  %wt.3 = (s32[], f32[4,8]{1,0}) tuple(%z.2, %g.2)
+  %w.3 = (s32[], f32[4,8]{1,0}) while(%wt.3), condition=%ncond.1, body=%nbody.1
+  ROOT %r.3 = f32[4,8]{1,0} get-tuple-element(%w.3), index=1
+}
+"""
+
+
+def test_trip_count_reader_on_synthetic_module():
+    """Grammar pin for the scan-trip-count reader: only the while whose
+    body holds a collective-permute counts (the permute-free inner loop —
+    the shape of the per-tile scans — is excluded), and the bound comes
+    from the compare-against-constant in its condition."""
+    from mpi_knn_tpu.analysis.rules import ring_scan_trip_counts
+
+    assert ring_scan_trip_counts(parse_hlo(_TRIP_SYNTH)) == [5]
 
 
 def test_fresh_dump_from_current_code_holds_the_property(tmp_path):

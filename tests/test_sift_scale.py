@@ -1,0 +1,87 @@
+"""CPU-mesh SIFT-shaped scale test (VERDICT r5 #7a): 32k×128 corpus, k=100
+— the carry layout and merge widths the small-k tier-1 tests never reach.
+
+What the shape buys:
+
+- the serial oracle runs its twolevel cascade over n_tiles·k = 128·100 =
+  12 800 survivor columns — far past the 8 192-wide corpus tile, so the
+  ≥2k-chunked cascade fold (``ops/topk.py cascade_smallest_k``) actually
+  cascades instead of degenerating to one sort;
+- the ring side carries a (q_local, 100) top-k across rounds with blocks
+  split into multiple on-device tiles — the k=100 carry end to end;
+- the run goes through the RESUMABLE driver with a mid-run checkpoint
+  kill, and the resumed result must be bit-identical to an uninterrupted
+  run (the acceptance bar for every resume path in this repo).
+
+Queries are a 384-row sample of the corpus carrying their corpus ids, so
+all-pairs self-exclusion semantics are exercised without paying the full
+32k×32k distance problem on a CPU (the corpus scale is what stresses the
+merge widths; the query count is not load-bearing).
+
+The ring runs the bidir schedule — the newest rotation path is the one
+that should carry the scale bar.
+"""
+
+import numpy as np
+
+from mpi_knn_tpu import KNNConfig, all_knn
+from mpi_knn_tpu.backends.ring_resumable import all_knn_ring_resumable
+
+
+def test_sift_shaped_k100_ring_resumable_kill_resume(rng, tmp_path):
+    m, d, k, nq = 32768, 128, 100, 384
+    X = rng.standard_normal((m, d)).astype(np.float32)
+    sample = np.linspace(0, m - 1, num=nq, dtype=np.int64)
+    Q = X[sample].copy()
+    qids = sample.astype(np.int32)
+    cfg = KNNConfig(k=k, query_tile=64, corpus_tile=256,
+                    ring_schedule="bidir")
+
+    # mid-run kill after 2 of the ⌊8/2⌋+1 = 5 bidir rounds
+    ck = tmp_path / "ck"
+    rounds = []
+    all_knn_ring_resumable(
+        X, Q, qids, cfg, checkpoint_dir=ck, stop_after_rounds=2,
+        progress_cb=lambda r, t: rounds.append((r, t)),
+    )
+    assert rounds == [(1, 5), (2, 5)]
+
+    rounds2 = []
+    dist, ids = all_knn_ring_resumable(
+        X, Q, qids, cfg, checkpoint_dir=ck,
+        progress_cb=lambda r, t: rounds2.append((r, t)),
+    )
+    assert rounds2 == [(3, 5), (4, 5), (5, 5)]  # resumed, not restarted
+
+    # bit-identity to an uninterrupted run — the resume contract at scale
+    d0, i0 = all_knn_ring_resumable(X, Q, qids, cfg)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(ids))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(dist))
+
+    # serial oracle: n_tiles·k = (32768/256)·100 = 12800-wide cascade.
+    # Distances must be BIT-equal (same per-pair kernel shapes on both
+    # sides). Ids must match after canonicalizing within-tie order: at 32k
+    # f32 candidates per query, distinct corpus rows do land on bit-equal
+    # distances, and the merge orders (one 128-tile cascade vs per-round
+    # block merges) may legally order such a tied pair either way — both
+    # top-k sets are identical, as the bit-equal distance rows prove.
+    want = all_knn(
+        X, queries=Q, query_ids=qids,
+        config=cfg.replace(backend="serial"),
+    )
+    wd, wi = np.asarray(want.dists), np.asarray(want.ids)
+    gd, gi = np.asarray(dist), np.asarray(ids)
+    np.testing.assert_array_equal(wd, gd)
+
+    def tie_canonical(dists_arr, ids_arr):
+        out = np.empty_like(ids_arr)
+        for r in range(ids_arr.shape[0]):
+            out[r] = ids_arr[r][np.lexsort((ids_arr[r], dists_arr[r]))]
+        return out
+
+    np.testing.assert_array_equal(tie_canonical(wd, wi), tie_canonical(gd, gi))
+    # k=100 sanity: every query returns 100 real, self-excluded neighbors
+    assert ids.shape == (nq, k)
+    got = np.asarray(ids)
+    assert (got >= 0).all()
+    assert not (got == qids[:, None]).any()
