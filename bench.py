@@ -220,8 +220,14 @@ def main() -> int:
         "unit": "s",
         "vs_baseline": round(vs, 3),
     }
-    _COMPLETED.set()  # suppress the watchdog from here on
-    print(json.dumps(line), flush=True)
+    # set-and-print must be atomic against the watchdog's check-and-print
+    # (a fired watchdog spends minutes in the fallback subprocess; the
+    # primary finishing in that window must not produce a SECOND stdout
+    # measurement line). The watchdog os._exits while holding this lock,
+    # so losing the race here means never reaching the duplicate print.
+    with _EMIT_LOCK:
+        _COMPLETED.set()  # suppress the watchdog from here on
+        print(json.dumps(line), flush=True)
     # context for humans / the judge, on stderr so stdout stays one line
     print(
         json.dumps(
@@ -248,39 +254,135 @@ def main() -> int:
 
 
 _COMPLETED = threading.Event()
+# serializes "check _COMPLETED, then print a measurement line" between the
+# main thread and the watchdog thread: stdout carries EXACTLY one
+# measurement line per run, whoever takes the lock first wins
+_EMIT_LOCK = threading.Lock()
+
+
+def _cpu_fallback_line():
+    """Re-run the bench on the CPU platform in a FRESH subprocess (the
+    wedged transport lives in this process; the fallback must not share
+    it) at a CPU-feasible corpus size. Returns the fallback's parsed JSON
+    measurement line, or None if it too failed.
+
+    4 of 5 r5 rounds banked only ``rc: 2`` watchdog JSON ("no measurement
+    completed") — a dead chip erased the whole round's signal. The CPU
+    number says nothing absolute about the TPU, but it pins the RELATIVE
+    per-round trajectory on the platform that always works (the
+    bench_ops.py rationale), which beats banking nothing.
+    """
+    if os.environ.get("BENCH_NO_FALLBACK") == "1":
+        return None  # recursion guard: the fallback itself never falls back
+    import subprocess
+
+    m = min(int(os.environ.get("BENCH_M", "60000")),
+            int(os.environ.get("BENCH_FALLBACK_M", "8000")))
+    env = dict(os.environ)
+    # serial CPU is the one configuration with no device transport, no
+    # mesh and no knob conflicts; strip ring/pallas knobs the forced
+    # backend would loudly refuse (their loud-exit-2 conflict checks are
+    # correct for user runs — the fallback must not trip them)
+    for k in ("BENCH_RING_SCHEDULE", "BENCH_RING_XFER",
+              "BENCH_PALLAS_VARIANT"):
+        env.pop(k, None)
+    env.update(
+        BENCH_PLATFORM="cpu",
+        BENCH_BACKEND="serial",
+        BENCH_M=str(m),
+        BENCH_WATCHDOG_S="0",  # the subprocess timeout below is the bound
+        BENCH_NO_FALLBACK="1",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "420")),
+        )
+    except Exception:
+        return None
+    for line in proc.stdout.splitlines():
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in doc and "value" in doc:
+            # the fallback reports under an explicitly-marked series name:
+            # a reduced m alone is NOT collision-proof (a genuine
+            # BENCH_M=8000 TPU series would share "mnist8k_..."), so the
+            # marker is part of the name and the degraded number can never
+            # poison any primary series; vs_baseline stays 0 — a CPU
+            # number does not beat a TPU north star by definition
+            doc["metric"] = doc["metric"] + "_cpu_fallback"
+            doc["vs_baseline"] = 0.0
+            doc["degraded"] = "cpu-fallback"
+            doc["fallback_of"] = metric_name()
+            return doc
+    return None
 
 
 def _watchdog_fire():
     # a wedged device transport hangs inside a native runtime call that
     # never returns — a signal handler would never run (the interpreter
-    # can't regain control), so a daemon THREAD emits an honest failure
-    # line (vs_baseline 0) and hard-exits instead of hanging the harness
+    # can't regain control), so a daemon THREAD takes over: it banks a
+    # degraded CPU-mesh measurement from a fresh process when it can, and
+    # only then falls back to the honest failure line (vs_baseline 0)
+    # before hard-exiting instead of hanging the harness
     if _COMPLETED.is_set():
         return  # raced with a just-finished run: its success line stands
-    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", "480"))
     print(
-        json.dumps(
-            {
-                # same series name a successful run reports; value is the
-                # timeout itself ("took at least this long") so
-                # lower-is-better aggregations are not poisoned by a
-                # negative sentinel
-                "metric": metric_name(),
-                "value": watchdog_s,
-                "unit": "s",
-                "vs_baseline": 0.0,
-                "failed": True,
-            }
-        ),
-        flush=True,
-    )
-    print(
-        json.dumps({"error": "watchdog: device unresponsive (wedged "
-                             "transport?); no measurement completed"}),
+        json.dumps({"warning": "watchdog fired (wedged transport?); "
+                               "attempting CPU fallback measurement"}),
         file=sys.stderr,
         flush=True,
     )
-    os._exit(2)
+    fallback = _cpu_fallback_line()
+    # check-and-print under the emit lock: the primary finishing during
+    # the minutes the fallback subprocess ran must not race us into a
+    # second stdout measurement line. os._exit below runs while the lock
+    # is held — a primary blocked on it dies with the process, before its
+    # duplicate print.
+    with _EMIT_LOCK:
+        if _COMPLETED.is_set():
+            return  # the primary finished while the fallback ran: it stands
+        if fallback is not None:
+            print(json.dumps(fallback), flush=True)
+            print(
+                json.dumps({
+                    "error": "watchdog: device unresponsive; banked a "
+                    "degraded cpu-fallback measurement instead",
+                    "fallback_metric": fallback["metric"],
+                }),
+                file=sys.stderr,
+                flush=True,
+            )
+            # the round banked a real (degraded, self-labeled) measurement
+            # — exit 0 so the harness records it instead of discarding it
+            os._exit(0)
+        watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", "480"))
+        print(
+            json.dumps(
+                {
+                    # same series name a successful run reports; value is
+                    # the timeout itself ("took at least this long") so
+                    # lower-is-better aggregations are not poisoned by a
+                    # negative sentinel
+                    "metric": metric_name(),
+                    "value": watchdog_s,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "failed": True,
+                }
+            ),
+            flush=True,
+        )
+        print(
+            json.dumps({"error": "watchdog: device unresponsive (wedged "
+                                 "transport?); no measurement completed"}),
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(2)
 
 
 if __name__ == "__main__":

@@ -19,7 +19,7 @@ Public API::
 
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.types import KNNResult
-from mpi_knn_tpu.api import all_knn, knn_classify
+from mpi_knn_tpu.api import all_knn, build_index, knn_classify, query_knn
 from mpi_knn_tpu.models.classifier import KNNClassifier
 
 __version__ = "0.1.0"
@@ -28,6 +28,8 @@ __all__ = [
     "KNNConfig",
     "KNNResult",
     "all_knn",
+    "build_index",
+    "query_knn",
     "knn_classify",
     "KNNClassifier",
     "__version__",

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "rotation) or bidir (full-duplex counter-rotation, whose "
                    "2-permutes-per-direction accounting R4 certifies); "
                    "repeatable")
+    p.add_argument("--serve", action="store_true",
+                   help="restrict to the serving-engine cells (the "
+                   "per-batch programs the executable cache compiles, "
+                   "whose donation/aliasing and no-corpus-copy contract "
+                   "R5 certifies)")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -85,6 +90,7 @@ def main(argv=None) -> int:
         and (not args.dtype or t.dtype in args.dtype)
         and (not args.policy or t.policy in args.policy)
         and (not args.schedule or t.schedule in args.schedule)
+        and (t.serve or not args.serve)
     ]
     if not targets:
         print("error: no targets match the given filters", file=sys.stderr)

@@ -49,13 +49,16 @@ LINT_M_MIXED, LINT_CORPUS_TILE_MIXED = 256, 32
 @dataclasses.dataclass(frozen=True)
 class LintTarget:
     """One cell of the backend × metric × dtype × precision-policy ×
-    ring-schedule matrix (``schedule`` only varies for ring backends)."""
+    ring-schedule × serve matrix (``schedule`` only varies for ring
+    backends; ``serve`` cells lint the per-batch program the serving
+    engine's executable cache compiles instead of the one-shot core)."""
 
     backend: str
     metric: str
     dtype: str
     policy: str = "exact"
     schedule: str = "uni"
+    serve: bool = False
 
     @property
     def label(self) -> str:
@@ -64,6 +67,8 @@ class LintTarget:
             base = f"{base}/{self.policy}"
         if self.schedule != "uni":
             base = f"{base}/{self.schedule}"
+        if self.serve:
+            base = f"{base}/serve"
         return base
 
 
@@ -91,6 +96,18 @@ def default_targets() -> list[LintTarget]:
         for b in RING_BACKENDS
         for m in METRICS
         for p in ("exact", "mixed")
+    ] + [
+        # the serving engine's per-batch programs (mpi_knn_tpu.serve):
+        # every backend at l2/float32 plus the mixed serial cell — R5
+        # certifies the scratch donation (input_output_alias/buffer_donor)
+        # and the no-resident-corpus-copy property; R1–R4 re-run on the
+        # serve lowering (same tile/rotation bodies, so the sequencing,
+        # memory, dtype and collective contracts must survive the serving
+        # wrapper unchanged)
+        LintTarget(b, "l2", "float32", serve=True)
+        for b in LINT_BACKENDS
+    ] + [
+        LintTarget("serial", "l2", "float32", "mixed", serve=True),
     ]
 
 
@@ -288,6 +305,56 @@ def _lower_pallas(target: LintTarget):
     return lowered, cfg, meta
 
 
+def _lower_serve(target: LintTarget):
+    """Lower the serving engine's per-batch program for one cell through
+    the PRODUCTION path: a real (small) CorpusIndex is built and
+    ``serve.engine.lower_bucket`` emits the exact Lowered the executable
+    cache would compile — a parallel lint-only reimplementation could
+    drift and certify a program nobody serves."""
+    from mpi_knn_tpu.serve import build_index
+    from mpi_knn_tpu.serve.engine import SCRATCH_PARAMS, lower_bucket
+
+    if target.backend == "pallas" and target.dtype != "float32":
+        raise UnsupportedTarget(
+            "pallas backend computes in float32 only (its own wrapper "
+            "rejects other dtypes)"
+        )
+    if target.backend in RING_BACKENDS and len(jax.devices()) < 2:
+        raise UnsupportedTarget(
+            "ring serve targets need a multi-device mesh (force the CPU "
+            "platform with virtual devices first, as the lint CLI does)"
+        )
+    _require_x64(target)
+    # the one-shot lowerers call their backend core directly, but the
+    # serving path resolves cfg.backend itself — pin it (the default
+    # "auto" would quietly build every cell a ring-overlap index)
+    cfg = _base_cfg(target).replace(
+        backend=target.backend, query_bucket=LINT_NQ, donate=True
+    )
+    m = _lint_m(target)
+    index = build_index(np.zeros((m, LINT_D), np.float32), cfg)
+    lowered, q_pad, q_tile = lower_bucket(index, index.cfg, LINT_NQ)
+    meta = {
+        "q_tile": q_tile,
+        "c_tile": index.c_tile,
+        "acc_bytes": _acc_bytes(target.dtype),
+        "serve": True,
+        # R5: the scratch params MUST carry the donation in the header,
+        # and nothing in the batch program may copy the resident corpus
+        "donated_params": SCRATCH_PARAMS if index.cfg.donate else (),
+        "resident_bytes": index.nbytes_resident,
+        **_mixed_meta(target, q_tile, index.c_tile),
+    }
+    if target.backend in RING_BACKENDS:
+        ring_n = index.ring_meta[3]
+        meta.update(
+            ring_n=ring_n,
+            ring_schedule=target.schedule,
+            expected_permutes=4 if target.schedule == "bidir" else 2,
+        )
+    return lowered, index.cfg, meta
+
+
 _LOWERERS = {
     "serial": _lower_serial,
     "ring": _lower_ring,
@@ -300,6 +367,9 @@ _LOWERERS = {
 def lower_target(target: LintTarget):
     """(texts_by_stage, cfg, meta) for one matrix cell, cached — the test
     matrix and the CLI share lowerings within a process."""
+    if target.serve:
+        lowered, cfg, meta = _lower_serve(target)
+        return hlo_texts(lowered), cfg, meta
     try:
         lowerer = _LOWERERS[target.backend]
     except KeyError:

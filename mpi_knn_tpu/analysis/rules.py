@@ -30,6 +30,15 @@ Shipped rules:
   direction — wrong-direction or missing permutes are findings);
   single-device backends must contain no collectives at all (a stray
   ``all-gather`` / ``all-reduce`` is a sharding leak).
+- **R5-donation** — donation/aliasing of the serving batch program. The
+  per-batch executable the serving engine compiles (``mpi_knn_tpu.serve``)
+  must declare its scratch donation in the module header (``buffer_donor``
+  before optimization / ``input_output_alias`` after — the compiled
+  program's proof that steady-state serving reuses the carry in place
+  rather than allocating per batch), and may not contain a
+  ``copy``/``copy-start`` of resident-corpus size in either stage — a
+  full-corpus copy inside the batch program would silently re-pay the
+  corpus upload the resident index exists to amortize.
 """
 
 from __future__ import annotations
@@ -651,6 +660,182 @@ def ring_scan_trip_counts(module: HloModule) -> list[int]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# R5: donation/aliasing of the serving batch program
+
+# module-header alias entry: `{output_index}: (param, {param_index}, kind)`
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{\s*(\d*)\s*\}\s*:\s*\(\s*(\d+)\s*,\s*\{[^}]*\}\s*,"
+    r"\s*(?:may|must)-alias\s*\)"
+)
+# buffer_donor entry (pre-optimization form on sharded programs, where the
+# concrete aliasing is resolved at compile time): `(param, {param_index})`
+_DONOR_ENTRY_RE = re.compile(r"\(\s*(\d+)\s*,\s*\{[^}]*\}\s*\)")
+
+
+def _header_group(header: str, attr: str) -> str | None:
+    """The balanced ``{...}`` payload of a module-header attribute."""
+    start = header.find(attr + "={")
+    if start < 0:
+        return None
+    i = start + len(attr) + 1
+    depth = 0
+    for j in range(i, len(header)):
+        if header[j] == "{":
+            depth += 1
+        elif header[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return header[i: j + 1]
+    return header[i:]
+
+
+def output_aliases(module: HloModule) -> dict[int, int]:
+    """``{output_index: param_number}`` from the module header's
+    ``input_output_alias`` (a single non-tuple output is index 0). Output
+    indices — not python argnums — are the stable coordinate: jax elides
+    unused arguments from the lowered program, renumbering parameters."""
+    grp = _header_group(module.header, "input_output_alias")
+    if not grp:
+        return {}
+    return {
+        int(out or 0): int(param)
+        for out, param in _ALIAS_ENTRY_RE.findall(grp)
+    }
+
+
+def donor_params(module: HloModule) -> set[int]:
+    """Parameter numbers declared in ``buffer_donor`` (the not-yet-resolved
+    donation form jax emits for sharded programs before optimization)."""
+    grp = _header_group(module.header, "buffer_donor")
+    if not grp:
+        return set()
+    return {int(p) for p in _DONOR_ENTRY_RE.findall(grp)}
+
+
+def entry_output_count(module: HloModule) -> int:
+    """Top-level output arity of the entry computation, read from the
+    header's ``entry_computation_layout`` ``->(...)`` group (1 for a
+    non-tuple output)."""
+    m = re.search(r"->", module.header)
+    if not m:
+        return 0
+    rest = module.header[m.end():].lstrip()
+    if not rest.startswith("("):
+        return 1
+    depth = 0
+    count = 1
+    for ch in rest:
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+            if depth == 0:
+                break
+        elif ch == "," and depth == 1:
+            count += 1
+    return count
+
+
+def oversized_copies(module: HloModule, threshold_bytes: int):
+    """``copy``/``copy-start`` instructions materializing a buffer of at
+    least ``threshold_bytes`` (async pairs: the ``-start`` carries the
+    semantics; ``copy-done`` returns the same buffer and is skipped)."""
+    out = []
+    for c in module.computations.values():
+        for i in c.instructions.values():
+            if i.opcode not in ("copy", "copy-start"):
+                continue
+            b = max_buffer_bytes(i.type_str)
+            if b >= threshold_bytes:
+                out.append((c.name, i.name, b))
+    return out
+
+
+@register
+class R5Donation(Rule):
+    name = "R5-donation"
+    description = (
+        "serving batch programs declare the per-batch scratch donation in "
+        "the module header (buffer_donor before opt, input_output_alias "
+        "after) and contain no resident-corpus-sized copy — steady-state "
+        "serving must reuse memory in place, not re-pay the corpus"
+    )
+
+    def applies(self, ctx) -> bool:
+        return bool(getattr(ctx.target, "serve", False))
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        out = []
+        if ctx.meta.get("donated_params"):
+            aliases = output_aliases(module)
+            n_out = entry_output_count(module)
+            unaliased = sorted(set(range(n_out)) - set(aliases))
+            if unaliased and stage == "after_opt":
+                # the compiled program is the ground truth: every output
+                # buffer must alias a donated input or each batch
+                # allocates fresh result+scratch memory — the in-place
+                # steady state the engine promises did not materialize
+                # (declared-but-dropped donation lands here too)
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.target.label,
+                        stage,
+                        f"output buffer(s) {unaliased} of {n_out} carry "
+                        "no input_output_alias in the compiled program — "
+                        "the donated scratch is not reused in place; "
+                        "every batch allocates fresh result memory",
+                        {"aliases": {str(k): v
+                                     for k, v in aliases.items()},
+                         "outputs": n_out},
+                    )
+                )
+            elif not aliases and not donor_params(module):
+                # before optimization the donation may still be the
+                # unresolved buffer_donor form (sharded programs); what is
+                # NOT acceptable is a serve program with no donation
+                # declaration at all
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.target.label,
+                        stage,
+                        "serve program declares no donation at all (no "
+                        "input_output_alias, no buffer_donor) — every "
+                        "batch allocates a fresh carry instead of "
+                        "reusing the donated one in place",
+                        {"outputs": n_out},
+                    )
+                )
+        resident = ctx.meta.get("resident_bytes", 0)
+        if resident:
+            # Deliberate blind spot, not an oversight: on ring cells the
+            # compiled SPMD module is per-shard, and a shard-sized copy is
+            # the ROTATION ITSELF (each round copies the traveling block —
+            # exactly c_pad/ring_n rows — through the loop state), so a
+            # per-shard threshold flags every correct ring program. A
+            # redundant local-shard copy is size-indistinguishable from
+            # that legitimate traffic; the census therefore keeps the
+            # GLOBAL corpus bound everywhere (it still catches full-corpus
+            # materializations, and R4's collective accounting covers the
+            # regather class on rings).
+            for comp, name, b in oversized_copies(module, resident):
+                out.append(
+                    Finding(
+                        self.name,
+                        ctx.target.label,
+                        stage,
+                        f"{comp}::{name} copies {b} bytes >= the resident "
+                        f"corpus ({resident} bytes) inside the per-batch "
+                        "program — the corpus the index amortized is being "
+                        "re-copied every batch",
+                        {"bytes": b, "resident_bytes": resident},
+                    )
+                )
+        return out
+
+
 @register
 class R4Collectives(Rule):
     name = "R4-collective"
@@ -806,3 +991,9 @@ class R4Collectives(Rule):
                 )
             )
         return out
+
+
+# registration order follows source position; the registry is presented in
+# rule-number order regardless (R5's helpers sit above R4 in the file so
+# they can share the R2 shape readers)
+RULES.sort(key=lambda r: r.name)

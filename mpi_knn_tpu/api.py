@@ -98,6 +98,34 @@ def all_knn(
     return KNNResult(dists=d, ids=i)
 
 
+def build_index(corpus, config: Optional[KNNConfig] = None, mesh=None,
+                **overrides):
+    """Build a device-resident corpus index for query serving — all
+    corpus-side work (tiling, global ids, squared norms, sharding,
+    centering mean) done once, reused by every :func:`query_knn` batch.
+    See ``mpi_knn_tpu.serve`` for the full engine."""
+    from mpi_knn_tpu.serve import build_index as _build
+
+    return _build(corpus, config=config, mesh=mesh, **overrides)
+
+
+def query_knn(queries, index, config: Optional[KNNConfig] = None,
+              **overrides) -> KNNResult:
+    """Queries-vs-resident-corpus top-k over a :func:`build_index` handle.
+
+    The serving counterpart of ``all_knn(corpus, queries=...)``: the corpus
+    never moves, query batches are padded to power-of-two row buckets, and
+    each (bucket, config) executable is AOT-compiled exactly once — a
+    steady-state query stream issues zero recompiles for ANY batch size
+    (machine-verified; see ``mpi_knn_tpu.serve``). Results are
+    bit-identical to the one-shot API on every backend, returned
+    host-resident with padding stripped (``ServeSession`` exposes the
+    padded device arrays for callers that chain device work)."""
+    from mpi_knn_tpu.serve import query_knn as _query
+
+    return _query(queries, index, config=config, **overrides)
+
+
 def knn_classify(
     result: KNNResult,
     labels,

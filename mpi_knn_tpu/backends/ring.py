@@ -447,6 +447,53 @@ def _ring_knn_sharded(
     return fn(queries, query_ids, corpus, corpus_ids)
 
 
+def ring_serve_sharded(
+    queries,
+    query_ids,
+    carry_d,
+    carry_i,
+    corpus,
+    corpus_ids,
+    cfg,
+    overlap,
+    mesh,
+    axis,
+    q_tile,
+    c_tile,
+    q_axis=None,
+):
+    """Queries-vs-resident-corpus ring batch: the full rotation of
+    :func:`_ring_knn_sharded` run against a corpus that STAYS sharded on
+    the mesh across batches (``serve.CorpusIndex``), with the per-batch
+    top-k scratch threaded in from outside via ``carry_in`` so the serving
+    engine can AOT-compile this per row bucket and donate the scratch
+    (the donated buffers alias the sharded outputs — lint rule R5 reads
+    that contract back from the module header). Batch-owned arrays first,
+    resident index after, mirroring ``backends.serial.serve_chunk``."""
+    body = functools.partial(
+        _ring_knn_local,
+        cfg=cfg,
+        overlap=overlap,
+        axis=axis,
+        q_tile=q_tile,
+        c_tile=c_tile,
+        vary_axes=tuple(mesh.axis_names),
+    )
+
+    def with_carry(q, qi, cd, ci, c, cids):
+        return body(q, qi, c, cids, carry_in=(cd, ci))
+
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
+    fn = shard_map(
+        with_carry,
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, qspec, cspec, cspec),
+        out_specs=(qspec, qspec),
+    )
+    return fn(queries, query_ids, carry_d, carry_i, corpus, corpus_ids)
+
+
 def all_knn_ring(
     corpus: np.ndarray,
     queries: np.ndarray,

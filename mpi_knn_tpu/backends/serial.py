@@ -29,7 +29,7 @@ from mpi_knn_tpu.ops.distance import pairwise_dist, sq_norms
 from mpi_knn_tpu.ops.rerank import compress_rerank_tile
 from mpi_knn_tpu.ops.topk import (
     cascade_smallest_k,
-    init_topk,
+    init_topk_tiles,
     mask_tile,
     smallest_k,
 )
@@ -163,18 +163,45 @@ def knn_chunk_update(
 ):
     """Merge a chunk of corpus tiles into the per-query top-k carry: scan
     over corpus tiles inside a map over query tiles. The one compiled core
-    behind both the serial backend and the resumable driver."""
+    behind both the serial backend and the resumable driver — the serving
+    path's :func:`serve_chunk` IS this body with the chunk norms hoisted
+    to index state, so the two can never drift."""
     acc = jnp.float64 if q_tiles.dtype == jnp.float64 else jnp.float32
     if cfg.metric == "l2":
         chunk_sq = jax.vmap(sq_norms)(chunk_tiles)
     else:
         chunk_sq = jnp.zeros(chunk_tiles.shape[:2], dtype=acc)
+    return serve_chunk(
+        q_tiles, qid_tiles, carry_d, carry_i,
+        chunk_tiles, chunk_ids, chunk_sq, cfg,
+    )
+
+
+def serve_chunk(
+    q_tiles: jax.Array,  # (QT, q_tile, d) one padded query batch
+    qid_tiles: jax.Array,  # (QT, q_tile)
+    carry_d: jax.Array,  # (QT, q_tile, k) per-batch scratch (donatable)
+    carry_i: jax.Array,
+    tiles: jax.Array,  # (T, c_tile, d) RESIDENT corpus tiles
+    tile_ids: jax.Array,  # (T, c_tile)
+    tile_sqs: jax.Array,  # (T, c_tile) norms precomputed at index build
+    cfg: KNNConfig,
+):
+    """One serving batch against a device-resident corpus index: the
+    queries-vs-corpus generalization of :func:`knn_chunk_update` with the
+    corpus-side work hoisted out of the batch entirely — tiles, global ids
+    AND squared norms arrive precomputed (``serve.CorpusIndex`` builds them
+    once), so the per-batch program is only the distance matmuls, masks and
+    the top-k merge. The serving engine (``serve.engine``) AOT-compiles
+    this per row bucket with ``carry_d``/``carry_i`` donated; argument
+    order therefore keeps the batch-owned buffers first and the resident
+    index last."""
 
     def per_query_tile(args):
         q_x, q_ids, cd, ci = args
         q_sq = sq_norms(q_x) if cfg.metric == "l2" else None
         return merge_tiles_into_carry(
-            q_x, q_ids, q_sq, chunk_tiles, chunk_ids, chunk_sq, cd, ci, cfg
+            q_x, q_ids, q_sq, tiles, tile_ids, tile_sqs, cd, ci, cfg
         )
 
     return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, carry_d, carry_i))
@@ -310,10 +337,8 @@ def all_knn_serial(
     )
 
     acc = jnp.float64 if q_tiles.dtype == jnp.float64 else jnp.float32
-    qt_count = q_pad // q_tile
-    carry_d, carry_i = init_topk(q_pad, cfg.k, dtype=acc)
-    carry_d = carry_d.reshape(qt_count, q_tile, cfg.k)
-    carry_i = carry_i.reshape(qt_count, q_tile, cfg.k)
+    carry_d, carry_i = init_topk_tiles(q_pad // q_tile, q_tile, cfg.k,
+                                       dtype=acc)
 
     best_d, best_i = knn_chunk_update(
         q_tiles, qid_tiles, corpus_tiles, corpus_tile_ids, carry_d, carry_i, cfg

@@ -11,6 +11,8 @@ Examples::
     python -m mpi_knn_tpu --data mnist --k 30 --loo
     python -m mpi_knn_tpu --data synthetic:2048x64c10 --backend ring-overlap
     python -m mpi_knn_tpu --data corpus.mat --svd 64 --k 10 --report out.json
+    python -m mpi_knn_tpu query --data corpus.mat --queries q.npy  # serving
+    python -m mpi_knn_tpu lint --serve                     # static analysis
 """
 
 from __future__ import annotations
@@ -155,9 +157,11 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _load_data(args):
-    """Returns (X, labels_or_None, source)."""
-    spec = args.data
+def load_corpus(spec: str, limit=None):
+    """Resolve a corpus spec ('mnist', 'digits', 'synthetic:MxDcC',
+    'sift:M', *.fvecs/bvecs, or a .mat path) to (X, labels_or_None,
+    source). Shared by the run driver and the ``query`` serving
+    subcommand (serve/cli.py)."""
     m = re.fullmatch(r"synthetic:(\d+)x(\d+)(?:c(\d+))?", spec)
     if m:
         from mpi_knn_tpu.data.synthetic import make_blobs
@@ -173,26 +177,26 @@ def _load_data(args):
     if spec == "mnist":
         from mpi_knn_tpu.data.mnist import load_mnist
 
-        X, y, src = load_mnist(m=args.limit or 60000)
+        X, y, src = load_mnist(m=limit or 60000)
         return X, y, f"mnist({src})"
     if spec == "digits":
         from mpi_knn_tpu.data.digits import load_digits
 
         X, y = load_digits()
-        if args.limit:
-            X, y = X[: args.limit], y[: args.limit]
+        if limit:
+            X, y = X[:limit], y[:limit]
         return X, y, "digits(real)"
     if spec.endswith((".fvecs", ".bvecs")):
         from mpi_knn_tpu.data.vecs import read_vecs
 
         try:
-            return read_vecs(spec, limit=args.limit), None, spec
+            return read_vecs(spec, limit=limit), None, spec
         except (FileNotFoundError, ValueError) as e:
             raise SystemExit(f"error: {e}")
     from mpi_knn_tpu.data.matfile import load_corpus_mat
 
     try:
-        X, y = load_corpus_mat(spec, limit=args.limit)
+        X, y = load_corpus_mat(spec, limit=limit)
     except FileNotFoundError:
         raise SystemExit(
             f"error: --data {spec!r} is not a file, 'mnist', a "
@@ -201,6 +205,11 @@ def _load_data(args):
     except ValueError as e:
         raise SystemExit(f"error: {e}")
     return X, y, spec
+
+
+def _load_data(args):
+    """Returns (X, labels_or_None, source)."""
+    return load_corpus(args.data, limit=args.limit)
 
 
 def _load_queries(path):
@@ -240,6 +249,13 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "query":
+        # query-serving subcommand: build a device-resident CorpusIndex
+        # and stream query batches through the bucketed AOT executable
+        # cache (mpi_knn_tpu.serve). Same routing pattern as lint.
+        from mpi_knn_tpu.serve.cli import main as query_main
+
+        return query_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.save_every is not None and args.save_every <= 0:

@@ -163,6 +163,24 @@ class KNNConfig:
     # is what makes "corpus_tile = whole corpus" requests safe at SIFT1M
     # scale. query_tile is never clamped by this cap — keep it modest.
     max_tile_elems: int = 1 << 28
+    # --- serving knobs (mpi_knn_tpu.serve) -------------------------------
+    # base row bucket of the query-serving engine: every query batch is
+    # padded up to the smallest query_bucket·2^j rows, and each (bucket,
+    # config) pair is AOT-compiled exactly once — steady-state serving
+    # issues zero recompiles because batch shapes quantize to a handful of
+    # buckets instead of one executable per raw batch size.
+    query_bucket: int = 1024
+    # how many batches the streaming engine may dispatch ahead of the
+    # oldest unconsumed result: depth 2 overlaps batch t+1's H2D transfer
+    # with batch t's compute (double buffering); 1 is fully synchronous.
+    dispatch_depth: int = 2
+    # donate the per-batch top-k scratch to the serving executable
+    # (donate_argnums): XLA aliases the scratch buffers to the outputs
+    # (machine-checked from the module's input_output_alias by lint rule
+    # R5), so steady-state serving reuses the same carry memory in place
+    # instead of allocating per batch. Off only for debugging (donated
+    # inputs are invalidated after the call).
+    donate: bool = True
 
     def __post_init__(self):
         if self.backend not in BACKENDS:
@@ -216,6 +234,14 @@ class KNNConfig:
                     "(DEFAULT compress, HIGHEST rerank); matmul_precision "
                     f"must be None, got {self.matmul_precision!r}"
                 )
+        if self.query_bucket < 1:
+            raise ValueError(
+                f"query_bucket must be >= 1, got {self.query_bucket}"
+            )
+        if self.dispatch_depth < 1:
+            raise ValueError(
+                f"dispatch_depth must be >= 1, got {self.dispatch_depth}"
+            )
         if self.topk_block < 1:
             raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")
         if self.k < 1:

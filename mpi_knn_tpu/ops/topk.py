@@ -34,6 +34,18 @@ def init_topk(num_queries: int, k: int, dtype=jnp.float32):
     return d, i
 
 
+def init_topk_tiles(num_tiles: int, tile_rows: int, k: int, dtype=jnp.float32):
+    """``init_topk`` pre-shaped to a (num_tiles, tile_rows, k) query-tile
+    stack — the carry layout of the tiled serial core and the serving
+    engine's per-batch scratch (one construction, so the backends and the
+    executable cache can never disagree about the scratch shape)."""
+    d, i = init_topk(num_tiles * tile_rows, k, dtype=dtype)
+    return (
+        d.reshape(num_tiles, tile_rows, k),
+        i.reshape(num_tiles, tile_rows, k),
+    )
+
+
 def _fold_topk(dists: jax.Array, ids: jax.Array, k: int, width: int):
     """Fold (q, c) candidate rows into (q, ceil(c/width)·k) by a per-chunk
     top-k: pad the columns to a multiple of ``width`` with (+inf, -1), sort

@@ -1,0 +1,36 @@
+"""Query-serving engine: device-resident corpus index, bucketed AOT
+executable cache, donated per-batch scratch, double-buffered dispatch.
+
+Public surface::
+
+    from mpi_knn_tpu.serve import build_index, query_knn, ServeSession
+
+    index = build_index(corpus, KNNConfig(k=10, backend="serial"))
+    res = query_knn(Q, index)              # one-shot, recompile-free when warm
+
+    session = ServeSession(index)          # streaming, dispatch-ahead
+    for batch_result in session.stream(batches):
+        use(batch_result.ids)
+
+Design rationale and the machine-checked donation/copy contract (lint
+rule R5): ``serve/engine.py`` docstring and DESIGN.md "Serving pipeline".
+"""
+
+from mpi_knn_tpu.serve.engine import (
+    BatchResult,
+    ServeSession,
+    bucket_rows,
+    get_executable,
+    query_knn,
+)
+from mpi_knn_tpu.serve.index import CorpusIndex, build_index
+
+__all__ = [
+    "BatchResult",
+    "CorpusIndex",
+    "ServeSession",
+    "bucket_rows",
+    "build_index",
+    "get_executable",
+    "query_knn",
+]

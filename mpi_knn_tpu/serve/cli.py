@@ -1,0 +1,248 @@
+"""``mpi-knn query`` — build a resident corpus index, stream query
+batches, report per-batch latency and end-to-end throughput.
+
+The serving counterpart of the one-shot run driver: the corpus is loaded
+and indexed ONCE (tiles/shards + norms + centering mean on device), then
+query batches stream through the bucketed AOT executable cache with
+bounded dispatch-ahead (``mpi_knn_tpu.serve``). Steady state issues zero
+recompiles; the summary line reports how many executables the whole run
+compiled so that claim is visible per invocation.
+
+Flag combinations the engine cannot honor are refused with a loud exit 2
+(the ``BENCH_RING_SCHEDULE`` convention: never silently measure a
+different configuration than the one requested) — e.g. a pallas index
+with a cosine metric or a non-float32 dtype, a mixed-precision query
+config over a bf16-compressed index, or a blocking-ring index on a
+multi-axis mesh.
+
+Examples::
+
+    mpi-knn query --data synthetic:8192x64c10 --synthetic 4096 --batch 512
+    mpi-knn query --data corpus.mat --queries q.npy --backend ring-overlap
+    mpi-knn query --data sift:100000 --synthetic 10000 --bucket 1024 \
+        --dispatch-depth 4 --report serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from mpi_knn_tpu.config import (
+    BACKENDS,
+    MERGE_SCHEDULES,
+    METRICS,
+    PRECISION_POLICIES,
+    RING_SCHEDULES,
+    TOPK_METHODS,
+    KNNConfig,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn query",
+        description="streamed query serving against a device-resident "
+        "corpus index (bucketed AOT executable cache, zero steady-state "
+        "recompiles)",
+    )
+    d = p.add_argument_group("data")
+    d.add_argument("--data", default="mnist",
+                   help="corpus spec (same forms as the run driver: "
+                   "'mnist', 'digits', 'synthetic:MxDcC', 'sift:M', "
+                   "*.fvecs/bvecs, or a .mat file)")
+    d.add_argument("--limit", type=int, default=None,
+                   help="use first N corpus rows only")
+    q = p.add_mutually_exclusive_group()
+    q.add_argument("--queries", default=None,
+                   help=".npy/.mat/.fvecs file of query points, streamed "
+                   "in --batch-row chunks")
+    q.add_argument("--synthetic", type=int, default=None, metavar="N",
+                   help="serve N synthetic query rows (corpus-distributed "
+                   "noise, corpus dim) instead of a file")
+    d.add_argument("--batch", type=int, default=256,
+                   help="rows per streamed batch (the final batch may be "
+                   "ragged; it pads to its bucket)")
+
+    k = p.add_argument_group("kNN / serving")
+    k.add_argument("--k", type=int, default=30)
+    k.add_argument("--metric", choices=METRICS, default="l2")
+    k.add_argument("--backend", choices=BACKENDS, default="auto")
+    k.add_argument("--devices", type=int, default=None,
+                   help="ring size for distributed backends")
+    k.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16", "float64"],
+                   help="resident/compute dtype; bfloat16 stores the "
+                   "index compressed at half width")
+    k.add_argument("--query-tile", type=int, default=1024)
+    k.add_argument("--corpus-tile", type=int, default=2048)
+    k.add_argument("--precision-policy", choices=list(PRECISION_POLICIES),
+                   default="exact")
+    k.add_argument("--topk-method", choices=list(TOPK_METHODS),
+                   default="exact")
+    k.add_argument("--merge-schedule", choices=list(MERGE_SCHEDULES),
+                   default="twolevel")
+    k.add_argument("--ring-schedule", choices=list(RING_SCHEDULES),
+                   default="uni")
+    k.add_argument("--bucket", type=int, default=1024,
+                   help="base row bucket: batches pad to bucket*2^j rows "
+                   "and each (bucket, config) compiles exactly once")
+    k.add_argument("--dispatch-depth", type=int, default=2,
+                   help="max batches in flight (2 = double buffering)")
+    k.add_argument("--no-donate", action="store_true",
+                   help="disable per-batch scratch donation (debugging)")
+
+    o = p.add_argument_group("output")
+    o.add_argument("--report", default=None, help="write JSON report here")
+    o.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                   default="auto")
+    o.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def _load_query_stream(args, X):
+    """(total_rows, iterator of np batches) from --queries / --synthetic."""
+    if args.synthetic is not None:
+        rng = np.random.default_rng(1)
+        dim = X.shape[1]
+        total = args.synthetic
+        lo, hi = float(np.min(X)), float(np.max(X))
+
+        def gen():
+            left = total
+            while left > 0:
+                n = min(args.batch, left)
+                yield rng.uniform(lo, hi, size=(n, dim)).astype(np.float32)
+                left -= n
+
+        return total, gen()
+    from mpi_knn_tpu.cli import _load_queries
+
+    Q = np.asarray(_load_queries(args.queries))
+    if Q.ndim != 2 or Q.shape[1] != X.shape[1]:
+        raise SystemExit(
+            f"error: queries shape {Q.shape} does not match corpus dim "
+            f"{X.shape[1]}"
+        )
+
+    def gen():
+        for s in range(0, len(Q), args.batch):
+            yield Q[s: s + args.batch]
+
+    return len(Q), gen()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.queries is None and args.synthetic is None:
+        print("error: provide a query stream (--queries FILE or "
+              "--synthetic N)", file=sys.stderr)
+        return 2
+    if args.batch < 1:
+        print("error: --batch must be >= 1", file=sys.stderr)
+        return 2
+    if args.synthetic is not None and args.synthetic < 1:
+        # a zero/negative stream would "succeed" with 0 queries served —
+        # a silent no-op where the convention demands a loud usage error
+        print("error: --synthetic must be >= 1", file=sys.stderr)
+        return 2
+
+    if args.platform != "auto":
+        from mpi_knn_tpu.utils.platform import force_platform
+
+        force_platform(args.platform)
+
+    from mpi_knn_tpu.cli import load_corpus
+    from mpi_knn_tpu.serve import ServeSession, build_index
+
+    X, _, source = load_corpus(args.data, limit=args.limit)
+
+    try:
+        cfg = KNNConfig(
+            k=args.k,
+            metric=args.metric,
+            backend=args.backend,
+            dtype=args.dtype,
+            query_tile=args.query_tile,
+            corpus_tile=args.corpus_tile,
+            precision_policy=args.precision_policy,
+            topk_method=args.topk_method,
+            merge_schedule=args.merge_schedule,
+            ring_schedule=args.ring_schedule,
+            num_devices=args.devices,
+            query_bucket=args.bucket,
+            dispatch_depth=args.dispatch_depth,
+            donate=not args.no_donate,
+        )
+    except ValueError as e:
+        # invalid knob combination (e.g. mixed policy over a non-f32
+        # dtype): loud usage error, never a silently-adjusted run
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    t_build0 = time.perf_counter()
+    try:
+        index = build_index(X, cfg)
+        session = ServeSession(index)
+    except ValueError as e:
+        # the engine cannot honor this combination (pallas+cosine,
+        # compressed index + mixed policy, blocking ring on a 2-D mesh…)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    build_s = time.perf_counter() - t_build0
+
+    total, stream = _load_query_stream(args, X)
+
+    t0 = time.perf_counter()
+    n_batches = 0
+    for res in session.stream(stream):
+        n_batches += 1
+        if not args.quiet:
+            print(
+                f"batch {n_batches - 1}: rows={res.rows} "
+                f"bucket={res.bucket} latency={res.latency_s * 1e3:.2f}ms"
+            )
+    wall = time.perf_counter() - t0
+
+    lats = np.asarray(session.latencies)
+    summary = {
+        "corpus": source,
+        "shape": list(X.shape),
+        "backend": index.backend,
+        "k": cfg.k,
+        "queries": session.queries_served,
+        "batches": n_batches,
+        "executables_compiled": len(index._cache),
+        "index_build_s": round(build_s, 4),
+        "wall_s": round(wall, 4),
+        "throughput_qps": round(session.queries_served / wall, 2)
+        if wall > 0 else None,
+        "latency_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3)
+        if len(lats) else None,
+        "latency_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
+        if len(lats) else None,
+    }
+    if not args.quiet:
+        print(
+            f"[mpi-knn query] {summary['queries']} queries in "
+            f"{summary['batches']} batches: {summary['throughput_qps']} q/s "
+            f"(p50 {summary['latency_p50_ms']}ms, "
+            f"p99 {summary['latency_p99_ms']}ms, "
+            f"{summary['executables_compiled']} executable(s) compiled, "
+            f"index build {summary['index_build_s']}s)"
+        )
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+        if not args.quiet:
+            print(f"report written to {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

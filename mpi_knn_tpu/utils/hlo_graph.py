@@ -93,6 +93,11 @@ class Computation:
 @dataclass
 class HloModule:
     computations: dict[str, Computation]
+    # the `HloModule name, attr=..., ...` line verbatim: module-scoped
+    # attributes (input_output_alias, buffer_donor, entry layout) live
+    # here, not on any instruction — the donation lint (analysis R5)
+    # reads them from this field
+    header: str = ""
 
     def find(self, opcode_prefix: str) -> list[tuple[str, str]]:
         """All (computation, instruction) whose opcode starts with prefix."""
@@ -147,8 +152,12 @@ def _parse_rhs(rhs: str) -> tuple[str, str, str, str]:
 def parse_hlo(text: str) -> HloModule:
     comps: dict[str, Computation] = {}
     cur: Computation | None = None
+    header = ""
     for line in text.splitlines():
         if cur is None:
+            if not header and line.startswith("HloModule"):
+                header = line.rstrip()
+                continue
             m = _COMP_HDR_RE.match(line)
             if m and line.rstrip().endswith("{"):
                 cur = Computation(name=m.group(2), is_entry=bool(m.group(1)))
@@ -199,7 +208,7 @@ def parse_hlo(text: str) -> HloModule:
         cur.instructions[name] = instr
         if is_root:
             cur.root = name
-    return HloModule(computations=comps)
+    return HloModule(computations=comps, header=header)
 
 
 def _call_sites(module: HloModule) -> dict[str, list[tuple[str, str]]]:

@@ -23,6 +23,12 @@ Two families, one JSON artifact:
   bench a real accelerator's per-op rows). On CPU the cells measure
   schedule mechanics (collectives are memcpys), pinning the per-PR
   trajectory; on a chip the same rows measure real ICI.
+- ``query_knn``: steady-state serving throughput over a resident
+  ``CorpusIndex`` (``mpi_knn_tpu.serve``) at three row buckets — per-batch
+  p50/p99 latency and queries/sec, measured strictly AFTER warm-up so the
+  rows pin the recompile-free steady state the engine promises (the
+  compile-free property itself is gated in tests/test_serve.py; these
+  rows pin its speed).
 
 CPU numbers say nothing absolute about the TPU — what they pin is the
 RELATIVE trajectory per op across PRs, on the platform CI always has
@@ -213,6 +219,54 @@ def main(argv=None) -> int:
                         reps,
                     ),
                 )
+
+    # -- query_knn serving throughput at three buckets (resident index) ---
+    from mpi_knn_tpu.serve import ServeSession, build_index
+
+    serve_cfg = KNNConfig(k=k, backend="serial", query_tile=min(1024, q),
+                          corpus_tile=min(8192, c), query_bucket=128)
+    index = build_index(X, serve_cfg)
+    for bucket in (128, 256, 512):
+        if bucket > c:
+            # no silent caps: a probe bucket wider than the corpus would
+            # quietly re-measure the widest real bucket under a bigger
+            # label (and warm an executable no batch ever uses)
+            print(f"note: skipping query_knn bucket {bucket} > corpus "
+                  f"rows {c}", file=sys.stderr)
+            continue
+        n_batches = max(reps, 4)
+        batches = [X[(i * bucket) % max(1, c - bucket):][:bucket]
+                   for i in range(n_batches)]
+        session = ServeSession(index)
+        session.warm([bucket])
+        # one full warm cycle through the session so the steady-state
+        # rows measure serving, not first-touch compilation
+        session.submit(batches[0])
+        session.drain()
+        session.reset_stats()
+        t0 = time.perf_counter()
+        for b in batches:
+            session.submit(b)
+        session.drain()
+        wall = time.perf_counter() - t0
+        lats = sorted(session.latencies)
+        row = {
+            "op": "query_knn",
+            "variant": f"serial-bucket{bucket}",
+            "median_s": round(statistics.median(lats), 6),
+            "min_s": round(min(lats), 6),
+            "reps_s": [round(t, 6) for t in lats],
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            # np.percentile, same estimator as serve/cli.py — at the
+            # default rep count this is an interpolated tail, honest
+            # about the small sample rather than one rank below p99
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "queries_per_s": round(session.queries_served / wall, 1),
+        }
+        results.append(row)
+        print(f"{'query_knn':16s} {row['variant']:16s} "
+              f"median {row['median_s']}s  {row['queries_per_s']} q/s",
+              flush=True)
 
     doc = {
         "schema": "bench_ops.v1",

@@ -54,7 +54,12 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # ring_schedule=bidir cells for both ring backends × metric × both
 # policies, where R4 certifies the full-duplex accounting (exactly 2
 # counter-directed collective-permutes per torus direction; wrong-direction
-# or missing permutes are findings); any finding fails the gate
+# or missing permutes are findings) — PLUS the serving-engine cells
+# (every backend's per-batch program from the bucketed executable cache,
+# `--serve` to run them alone), where R5 certifies the scratch donation
+# (every output aliased to a donated input in the compiled program) and
+# that nothing copies the resident corpus per batch; any finding fails
+# the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
 
 note "tier-1 pytest (the ROADMAP.md gate)"

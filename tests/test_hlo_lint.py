@@ -28,12 +28,12 @@ from mpi_knn_tpu.analysis import rules as rules_mod
 from mpi_knn_tpu.config import KNNConfig
 
 
-def _ctx(backend="serial", metric="l2", dtype="float32", **meta):
+def _ctx(backend="serial", metric="l2", dtype="float32", serve=False, **meta):
     meta.setdefault("q_tile", 8)
     meta.setdefault("c_tile", 16)
     meta.setdefault("acc_bytes", 8 if dtype == "float64" else 4)
     return engine.LintContext(
-        target=lowering.LintTarget(backend, metric, dtype),
+        target=lowering.LintTarget(backend, metric, dtype, serve=serve),
         cfg=KNNConfig(k=4, metric=metric, query_tile=8, corpus_tile=16),
         meta=meta,
     )
@@ -329,6 +329,157 @@ ENTRY %main.1 (a.1: bf16[4,8]) -> bf16[4,4] {
         {"before_opt": mod}, _ctx(dtype="bfloat16"), _rules("R3-dtype")
     )
     assert findings and "bf16 dot" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# R5: donation/aliasing of the serving batch program
+
+_SERVE_BODY = """\
+
+ENTRY %main.1 (q.1: f32[8,32], c.1: f32[8,4], ci.1: s32[8,4], t.1: f32[128,32]) -> (f32[8,4], s32[8,4]) {
+  %q.1 = f32[8,32]{1,0} parameter(0)
+  %c.1 = f32[8,4]{1,0} parameter(1)
+  %ci.1 = s32[8,4]{1,0} parameter(2)
+  %t.1 = f32[128,32]{1,0} parameter(3)
+  ROOT %r.1 = (f32[8,4]{1,0}, s32[8,4]{1,0}) tuple(%c.1, %ci.1)
+}
+"""
+
+_SERVE_LAYOUT = (
+    "entry_computation_layout={(f32[8,32]{1,0}, f32[8,4]{1,0}, "
+    "s32[8,4]{1,0}, f32[128,32]{1,0})->(f32[8,4]{1,0}, s32[8,4]{1,0})}"
+)
+
+# a correct serve module: both outputs alias the donated scratch pair
+_SERVE_OK = (
+    "HloModule m, input_output_alias={ {0}: (1, {}, may-alias), "
+    "{1}: (2, {}, may-alias) }, " + _SERVE_LAYOUT + _SERVE_BODY
+)
+# counterexample 1: donation missing entirely (no alias, no buffer_donor)
+_SERVE_NO_DONATION = "HloModule m, " + _SERVE_LAYOUT + _SERVE_BODY
+# counterexample 2: donation resolved for only ONE of the two outputs —
+# the other output allocates fresh memory every batch
+_SERVE_HALF_ALIASED = (
+    "HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }, "
+    + _SERVE_LAYOUT + _SERVE_BODY
+)
+# before-opt sharded form: buffer_donor declared, aliases not yet resolved
+_SERVE_DONOR_ONLY = (
+    "HloModule m, buffer_donor={ (1, {}), (2, {}) }, "
+    + _SERVE_LAYOUT + _SERVE_BODY
+)
+
+
+def _serve_ctx():
+    # resident corpus at these shapes: 128×32 f32 = 16384 bytes
+    return _ctx(serve=True, donated_params=(2, 3), resident_bytes=128 * 32 * 4)
+
+
+def test_r5_passes_the_aliased_serve_program():
+    findings, ran = engine.run_rules(
+        {"before_opt": _SERVE_OK, "after_opt": _SERVE_OK},
+        _serve_ctx(),
+        _rules("R5-donation"),
+    )
+    assert ran == ["R5-donation"]
+    assert not findings, [f.message for f in findings]
+
+
+def test_r5_skips_non_serve_targets():
+    findings, ran = engine.run_rules(
+        {"before_opt": _SERVE_NO_DONATION}, _ctx(), _rules("R5-donation")
+    )
+    assert ran == []
+    assert not findings
+
+
+def test_r5_catches_missing_donation():
+    """A serve program with no donation declaration at all — every batch
+    allocates a fresh carry — must be a finding in both stages."""
+    findings, _ = engine.run_rules(
+        {"before_opt": _SERVE_NO_DONATION, "after_opt": _SERVE_NO_DONATION},
+        _serve_ctx(),
+        _rules("R5-donation"),
+    )
+    assert {f.stage for f in findings} == {"before_opt", "after_opt"}
+    assert any("no donation" in f.message for f in findings)
+
+
+def test_r5_catches_dropped_alias_in_compiled_program():
+    """Donation declared but resolved for only one output in the compiled
+    program: the other result buffer silently allocates per batch."""
+    findings, _ = engine.run_rules(
+        {"after_opt": _SERVE_HALF_ALIASED}, _serve_ctx(),
+        _rules("R5-donation"),
+    )
+    assert findings
+    assert "output buffer(s) [1]" in findings[0].message
+
+
+def test_r5_accepts_unresolved_buffer_donor_before_opt():
+    """The sharded before-opt form declares buffer_donor without concrete
+    aliases — a declaration, not a violation (the after-opt check is
+    where resolution is enforced)."""
+    findings, _ = engine.run_rules(
+        {"before_opt": _SERVE_DONOR_ONLY}, _serve_ctx(),
+        _rules("R5-donation"),
+    )
+    assert not findings, [f.message for f in findings]
+
+
+def test_r5_catches_full_corpus_copy():
+    """A copy of resident-corpus size inside the per-batch program re-pays
+    the upload the index exists to amortize — a finding even when the
+    donation itself is clean."""
+    body_with_copy = _SERVE_BODY.replace(
+        "  ROOT %r.1",
+        "  %cp.1 = f32[128,32]{1,0} copy(%t.1)\n  ROOT %r.1",
+    )
+    mod = (
+        "HloModule m, input_output_alias={ {0}: (1, {}, may-alias), "
+        "{1}: (2, {}, may-alias) }, " + _SERVE_LAYOUT + body_with_copy
+    )
+    findings, _ = engine.run_rules(
+        {"after_opt": mod}, _serve_ctx(), _rules("R5-donation")
+    )
+    assert findings
+    assert any("resident corpus" in f.message for f in findings)
+    # a small (block-sized) copy is the rotation's legitimate loop-state
+    # traffic and must NOT be flagged
+    small = _SERVE_BODY.replace(
+        "  ROOT %r.1",
+        "  %cp.1 = f32[16,32]{1,0} copy(%q.1)\n  ROOT %r.1",
+    )
+    mod_small = (
+        "HloModule m, input_output_alias={ {0}: (1, {}, may-alias), "
+        "{1}: (2, {}, may-alias) }, " + _SERVE_LAYOUT + small
+    )
+    findings2, _ = engine.run_rules(
+        {"after_opt": mod_small}, _serve_ctx(), _rules("R5-donation")
+    )
+    assert not findings2, [f.message for f in findings2]
+
+
+def test_r5_header_readers():
+    from mpi_knn_tpu.analysis.rules import (
+        donor_params,
+        entry_output_count,
+        output_aliases,
+    )
+    from mpi_knn_tpu.utils.hlo_graph import parse_hlo
+
+    mod = parse_hlo(_SERVE_OK)
+    assert output_aliases(mod) == {0: 1, 1: 2}
+    assert entry_output_count(mod) == 2
+    assert donor_params(parse_hlo(_SERVE_DONOR_ONLY)) == {1, 2}
+    # single (non-tuple) output counts as 1, aliased at index 0
+    single = (
+        "HloModule m, input_output_alias={ {}: (0, {}, may-alias) }, "
+        "entry_computation_layout={(f32[8,8]{1,0})->f32[8,8]{1,0}}\n"
+    )
+    mod1 = parse_hlo(single)
+    assert entry_output_count(mod1) == 1
+    assert output_aliases(mod1) == {0: 0}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,410 @@
+"""Serving-engine gate (ISSUE 4 tentpole): parity of the streamed
+query-serving path with the one-shot API, the bucketed AOT executable
+cache's zero-recompile steady state (counted at the JAX compiler level,
+not trusted from the engine's own bookkeeping), and the engine's loud
+refusals.
+
+Parity is asserted BIT-identical, not allclose: the serving path runs the
+same tile reductions over the same centered values (the index precomputes
+corpus norms under jit precisely so eager-vs-traced reduction bits cannot
+diverge), so any difference is a real divergence, not noise. Data is
+random normal — no distance ties, so merge order cannot permute ids.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_knn_tpu import KNNConfig, all_knn, build_index, query_knn
+from mpi_knn_tpu.serve import ServeSession, bucket_rows
+from mpi_knn_tpu.serve.engine import get_executable
+
+
+def _data(rng, m=256, d=16):
+    return rng.standard_normal((m, d)).astype(np.float32)
+
+
+def _cfg(backend, **kw):
+    kw.setdefault("k", 4)
+    kw.setdefault("query_tile", 16)
+    kw.setdefault("corpus_tile", 32)
+    kw.setdefault("query_bucket", 16)
+    return KNNConfig(backend=backend, **kw)
+
+
+@pytest.fixture
+def compile_counter():
+    """Count XLA backend compiles via jax.monitoring — the machine check
+    that a 'cache hit' really compiled nothing, independent of the
+    engine's own cache bookkeeping."""
+    from jax import monitoring
+
+    counts = []
+
+    def listener(name, secs, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            counts.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    try:
+        yield counts
+    finally:
+        monitoring.clear_event_listeners()
+
+
+# ---------------------------------------------------------------------------
+# bucket math
+
+
+def test_bucket_rows():
+    assert bucket_rows(1, 16) == 16
+    assert bucket_rows(16, 16) == 16
+    assert bucket_rows(17, 16) == 32
+    assert bucket_rows(33, 16) == 64
+    assert bucket_rows(5, 5) == 5
+    assert bucket_rows(11, 5) == 20
+    with pytest.raises(ValueError):
+        bucket_rows(0, 16)
+
+
+# ---------------------------------------------------------------------------
+# serving parity: query_knn vs the all_knn-derived oracle
+
+
+@pytest.mark.parametrize(
+    "backend", ["serial", "ring", "ring-overlap", "pallas"]
+)
+@pytest.mark.parametrize("policy", ["exact", "mixed"])
+def test_query_parity_vs_all_knn(rng, backend, policy):
+    """query_knn over a resident index is bit-identical to a fresh
+    all_knn(corpus, queries=...) call — every backend, both precision
+    policies (m=256/c_tile=32 keeps 4k=16 < c_tile so mixed genuinely
+    compresses, including per ring block)."""
+    X, Q = _data(rng), _data(rng, m=24)
+    cfg = _cfg(backend, precision_policy=policy)
+    want = all_knn(X, queries=Q, config=cfg)
+    idx = build_index(X, cfg)
+    got = query_knn(Q, idx)
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(got.ids))
+    np.testing.assert_array_equal(
+        np.asarray(want.dists), np.asarray(got.dists)
+    )
+
+
+@pytest.mark.parametrize("metric", ["l2", "cosine"])
+def test_query_parity_metrics_serial(rng, metric):
+    X, Q = _data(rng), _data(rng, m=24)
+    cfg = _cfg("serial", metric=metric)
+    want = all_knn(X, queries=Q, config=cfg)
+    idx = build_index(X, cfg)
+    got = query_knn(Q, idx)
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(got.ids))
+    np.testing.assert_array_equal(
+        np.asarray(want.dists), np.asarray(got.dists)
+    )
+
+
+def test_bucket_boundary_sizes(rng):
+    """Batch sizes straddling every bucket boundary (1, b−1, b, b+1, and
+    the next bucket's boundary) all pad+mask to the all_knn answer — a
+    ragged batch is bit-identical to its unpadded self."""
+    X = _data(rng)
+    cfg = _cfg("serial")
+    idx = build_index(X, cfg)
+    Qfull = _data(rng, m=40)
+    for n in (1, 15, 16, 17, 31, 32, 33):
+        Q = Qfull[:n]
+        want = all_knn(X, queries=Q, config=cfg)
+        got = query_knn(Q, idx)
+        assert got.ids.shape == (n, cfg.k)
+        np.testing.assert_array_equal(
+            np.asarray(want.ids), np.asarray(got.ids)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(want.dists), np.asarray(got.dists)
+        )
+
+
+def test_device_and_host_queries_bit_identical(rng):
+    """The same query batch, host numpy vs device-resident, produces
+    bit-identical results over one index (the test_device_resident.py
+    contract extended to the serving path)."""
+    X, Q = _data(rng), _data(rng, m=24)
+    for backend in ("serial", "ring-overlap", "pallas"):
+        idx = build_index(X, _cfg(backend))
+        host = query_knn(Q, idx)
+        dev = query_knn(jax.device_put(jnp.asarray(Q)), idx)
+        np.testing.assert_array_equal(
+            np.asarray(host.ids), np.asarray(dev.ids)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(host.dists), np.asarray(dev.dists)
+        )
+
+
+def test_device_resident_corpus_index(rng):
+    """An index built from a device-resident corpus serves the same
+    answers as all_knn over that device corpus (per-residency parity —
+    the centering mean is residency-specific by documented contract)."""
+    X, Q = _data(rng), _data(rng, m=24)
+    Xd = jax.device_put(jnp.asarray(X))
+    cfg = _cfg("serial")
+    want = all_knn(Xd, queries=Q, config=cfg)
+    idx = build_index(Xd, cfg)
+    got = query_knn(Q, idx)
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(got.ids))
+    np.testing.assert_array_equal(
+        np.asarray(want.dists), np.asarray(got.dists)
+    )
+
+
+# ---------------------------------------------------------------------------
+# the executable cache: zero steady-state compiles, no fingerprint collisions
+
+
+def test_steady_state_serving_is_recompile_free(rng, compile_counter):
+    """After one warm pass per bucket, a stream of batches across ≥3
+    bucket sizes — ragged sizes included — triggers ZERO XLA compiles
+    (the acceptance bar: steady-state serving is recompile-free, counted
+    at the compiler, not inferred from cache bookkeeping)."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    session = ServeSession(idx)
+    Qfull = _data(rng, m=64)
+
+    # warm-up: one full submit+drain cycle per bucket (16, 32, 64) so the
+    # executables AND the tiny host-visible glue ops are all cached
+    for n in (16, 32, 64):
+        session.submit(Qfull[:n])
+    session.drain()
+    assert len(idx._cache) == 3
+
+    compile_counter.clear()
+    served = []
+    for n in (16, 9, 32, 33, 64, 1, 24):  # every bucket, ragged included
+        served.extend(session.submit(Qfull[:n]))
+    served.extend(session.drain())
+    assert compile_counter == [], (
+        f"steady-state serving compiled {len(compile_counter)} program(s)"
+    )
+    assert len(idx._cache) == 3  # no new executables either
+    assert [r.rows for r in served] == [16, 9, 32, 33, 64, 1, 24]
+    # one-shot query_knn is equally compile-free at a warm bucket for a
+    # NEVER-SEEN ragged size: results strip on host, never via a
+    # per-raw-size device slice program
+    compile_counter.clear()
+    ragged = query_knn(Qfull[:13], idx)
+    assert compile_counter == [], "ragged one-shot query compiled"
+    # and the served answers are right (ragged batches included)
+    want = all_knn(X, queries=Qfull[:24], config=idx.cfg)
+    np.testing.assert_array_equal(np.asarray(want.ids), served[-1].ids)
+    np.testing.assert_array_equal(np.asarray(want.dists), served[-1].dists)
+    want13 = all_knn(X, queries=Qfull[:13], config=idx.cfg)
+    np.testing.assert_array_equal(np.asarray(want13.ids), ragged.ids)
+
+
+def test_second_batch_of_each_bucket_is_a_cache_hit(rng, compile_counter):
+    """Per bucket size: the first batch compiles (>0), the second batch of
+    the SAME bucket compiles nothing. Shapes are unique to this test
+    (d=24): jax's process-level compilation cache would otherwise satisfy
+    the 'first' compile from another test's identical program and make
+    the >0 half of the assertion vacuously fail."""
+    X = _data(rng, m=192, d=24)
+    idx = build_index(X, _cfg("serial"))
+    Qfull = _data(rng, m=64, d=24)
+    for n in (16, 32, 64):
+        compile_counter.clear()
+        query_knn(Qfull[:n], idx)
+        assert len(compile_counter) > 0, f"first bucket-{n} batch cached?"
+        compile_counter.clear()
+        query_knn(Qfull[:n], idx)
+        assert compile_counter == [], f"second bucket-{n} batch compiled"
+
+
+def test_config_fingerprints_never_collide(rng):
+    """Distinct query configs occupy distinct cache cells at the same
+    bucket — and each serves its own (correct) program."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    Q = _data(rng, m=16)
+    r4 = query_knn(Q, idx)  # k=4 (index default)
+    r5 = query_knn(Q, idx, k=5)
+    r4b = query_knn(Q, idx, topk_method="block")
+    nd = query_knn(Q, idx, donate=False)
+    assert len(idx._cache) == 4  # (bucket 16) × 4 distinct fingerprints
+    assert {b for b, _ in idx._cache} == {16}
+    assert r5.ids.shape == (16, 5)
+    np.testing.assert_array_equal(
+        np.asarray(r4.ids), np.asarray(r5.ids[:, :4])
+    )
+    np.testing.assert_array_equal(np.asarray(r4.ids), np.asarray(r4b.ids))
+    np.testing.assert_array_equal(np.asarray(r4.ids), np.asarray(nd.ids))
+
+
+def test_donated_scratch_is_consumed(rng):
+    """cfg.donate really donates: the carry buffers the engine passes are
+    invalidated by the call (in-place reuse), and donate=False leaves
+    donation off — both visible through the compiled executable's
+    input_output_alias (asserted structurally in test_hlo_lint.py; here
+    we pin the end-to-end behavioral difference: both configurations
+    serve identical answers)."""
+    X, Q = _data(rng), _data(rng, m=16)
+    idx = build_index(X, _cfg("serial"))
+    d = query_knn(Q, idx, donate=True)
+    nd = query_knn(Q, idx, donate=False)
+    np.testing.assert_array_equal(np.asarray(d.ids), np.asarray(nd.ids))
+    np.testing.assert_array_equal(np.asarray(d.dists), np.asarray(nd.dists))
+
+
+# ---------------------------------------------------------------------------
+# the streaming session
+
+
+def test_stream_order_latency_and_depth(rng):
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial", dispatch_depth=2))
+    session = ServeSession(idx)
+    batches = [_data(rng, m=n) for n in (16, 16, 10, 16)]
+    out = list(session.stream(iter(batches)))
+    assert [r.rows for r in out] == [16, 16, 10, 16]
+    assert session.queries_served == 58
+    assert len(session.latencies) == 4
+    assert all(lat > 0 for lat in session.latencies)
+    # depth bound held: nothing left in flight after the stream
+    assert not session._inflight
+    for q, r in zip(batches, out):
+        want = all_knn(X, queries=q, config=idx.cfg)
+        np.testing.assert_array_equal(np.asarray(want.ids), r.ids)
+
+
+def test_stream_depth_one_is_synchronous(rng):
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial", dispatch_depth=1))
+    session = ServeSession(idx)
+    done = session.submit(_data(rng, m=16))
+    assert len(done) == 1 and done[0].latency_s is not None
+    assert not session._inflight
+
+
+# ---------------------------------------------------------------------------
+# refusals: combinations the engine cannot honor fail loudly
+
+
+def test_refuses_pallas_cosine(rng):
+    with pytest.raises(ValueError, match="cosine"):
+        build_index(_data(rng), _cfg("pallas", metric="cosine"))
+
+
+def test_refuses_pallas_non_f32(rng):
+    with pytest.raises(ValueError, match="float32"):
+        build_index(_data(rng), _cfg("pallas", dtype="bfloat16"))
+
+
+def test_refuses_corpus_side_config_changes(rng):
+    idx = build_index(_data(rng), _cfg("serial"))
+    with pytest.raises(ValueError, match="corpus-side"):
+        query_knn(_data(rng, m=8), idx, corpus_tile=64)
+    with pytest.raises(ValueError, match="corpus-side"):
+        query_knn(_data(rng, m=8), idx, backend="pallas")
+
+
+def test_refuses_mixed_over_compressed_index(rng):
+    idx = build_index(_data(rng), _cfg("serial", dtype="bfloat16"))
+    with pytest.raises(ValueError):
+        query_knn(_data(rng, m=8), idx, precision_policy="mixed")
+
+
+def test_refuses_blocking_ring_on_2d_mesh(rng):
+    from mpi_knn_tpu.parallel.mesh import make_mesh2d
+
+    with pytest.raises(ValueError, match="multi-axis"):
+        build_index(
+            _data(rng), _cfg("ring"), mesh=make_mesh2d(2, 4)
+        )
+
+
+def test_config_serve_knob_validation():
+    with pytest.raises(ValueError, match="query_bucket"):
+        KNNConfig(query_bucket=0)
+    with pytest.raises(ValueError, match="dispatch_depth"):
+        KNNConfig(dispatch_depth=0)
+
+
+def test_query_cli_refusals_exit_2():
+    from mpi_knn_tpu.serve import cli as serve_cli
+
+    # no query stream at all
+    assert serve_cli.main(["--data", "synthetic:64x8c2"]) == 2
+    # engine refusal surfaces as the loud exit-2 convention
+    assert serve_cli.main(
+        ["--data", "synthetic:64x8c2", "--synthetic", "8",
+         "--backend", "pallas", "--metric", "cosine"]
+    ) == 2
+    # invalid knob combination caught at config level
+    assert serve_cli.main(
+        ["--data", "synthetic:64x8c2", "--synthetic", "8",
+         "--dtype", "bfloat16", "--precision-policy", "mixed"]
+    ) == 2
+
+
+def test_query_cli_end_to_end(tmp_path):
+    from mpi_knn_tpu.serve import cli as serve_cli
+
+    report = tmp_path / "serve.json"
+    rc = serve_cli.main(
+        ["--data", "synthetic:128x16c4", "--synthetic", "40",
+         "--batch", "16", "--bucket", "16", "--k", "3", "--backend",
+         "serial", "--report", str(report), "-q"]
+    )
+    assert rc == 0
+    import json
+
+    doc = json.loads(report.read_text())
+    assert doc["queries"] == 40
+    assert doc["batches"] == 3
+    assert doc["throughput_qps"] > 0
+    assert doc["latency_p50_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# compressed / sharded index layouts
+
+
+def test_bf16_compressed_index_matches_bf16_all_knn(rng):
+    """dtype='bfloat16' at build time IS the compressed-index mode: half
+    the resident bytes, parity with the one-shot bf16 path."""
+    X, Q = _data(rng), _data(rng, m=16)
+    cfg = _cfg("serial", dtype="bfloat16")
+    want = all_knn(X, queries=Q, config=cfg)
+    idx = build_index(X, cfg)
+    f32_idx = build_index(X, _cfg("serial"))
+    assert idx.nbytes_resident * 2 == f32_idx.nbytes_resident
+    got = query_knn(Q, idx)
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(got.ids))
+
+
+def test_ring_index_with_transfer_compression(rng):
+    """Ring serving composes with ring_transfer_dtype (the rotating block
+    circulates at bf16) exactly like the one-shot ring path."""
+    X, Q = _data(rng), _data(rng, m=24)
+    cfg = _cfg("ring-overlap", ring_transfer_dtype="bfloat16")
+    want = all_knn(X, queries=Q, config=cfg)
+    idx = build_index(X, cfg)
+    got = query_knn(Q, idx)
+    np.testing.assert_array_equal(np.asarray(want.ids), np.asarray(got.ids))
+    np.testing.assert_array_equal(
+        np.asarray(want.dists), np.asarray(got.dists)
+    )
+
+
+def test_get_executable_shapes(rng):
+    """The executable's padded rows always cover the bucket and respect
+    the tile alignment contract."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    for bucket in (16, 32, 128):
+        ex = get_executable(idx, idx.cfg, bucket)
+        assert ex.q_pad >= bucket
+        assert ex.q_pad % ex.q_tile == 0
