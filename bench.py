@@ -27,7 +27,11 @@ BENCH_REPS, BENCH_QT/BENCH_CT (tiles), BENCH_TOPK (exact|approx),
 BENCH_PRECISION (default|high|highest), BENCH_PRECISION_POLICY
 (exact|mixed — mixed is the compress-and-rerank pipeline and owns both dot
 precisions, so it overrides BENCH_PRECISION),
-BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_WATCHDOG_S (0 disables),
+BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_IVF_PARTITIONS /
+BENCH_IVF_NPROBE (clustered-index path: k-means partitions trained
+outside the timed region, per-query probed scan timed; the series name
+carries the knobs and the gate is the configured recall_target — the
+clustered rung's own acceptance bar), BENCH_WATCHDOG_S (0 disables),
 BENCH_PLATFORM (forces jax_platforms via the config API — JAX_PLATFORMS
 alone is ignored by the axon TPU plugin), TKNN_MNIST (real data path;
 synthetic surrogate otherwise).
@@ -54,10 +58,18 @@ RECALL_GATE = 0.999
 
 def metric_name() -> str:
     """One construction of the series name, shared by the success and
-    watchdog paths so a failure always lands in the real series."""
+    watchdog paths so a failure always lands in the real series. The IVF
+    knobs are part of the name: a clustered run measures a different
+    computation (sublinear probed scan at a measured recall target) and
+    must never masquerade as the exact full-scan series."""
     m = int(os.environ.get("BENCH_M", "60000"))
     k = int(os.environ.get("BENCH_K", "10"))
-    return f"mnist{m // 1000}k_allknn_k{k}_seconds"
+    ivf = ""
+    if os.environ.get("BENCH_IVF_PARTITIONS"):
+        p = os.environ["BENCH_IVF_PARTITIONS"]
+        n = os.environ.get("BENCH_IVF_NPROBE", "auto")
+        ivf = f"_ivf{p}p{n}"
+    return f"mnist{m // 1000}k_allknn_k{k}{ivf}_seconds"
 
 
 def oracle_topk(X: np.ndarray, sample: np.ndarray, k: int) -> np.ndarray:
@@ -133,6 +145,69 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    # BENCH_IVF_PARTITIONS=P: the clustered (IVF) path — the corpus is
+    # k-means-partitioned once OUTSIDE the timed region (index build is
+    # the amortized half, like the data upload), and each timed rep is
+    # the full all-pairs query sweep probing only BENCH_IVF_NPROBE
+    # partitions per query (unset = auto-tuned to cfg.recall_target). The
+    # series name carries the knobs, and the recall gate for IVF rows is
+    # the configured recall_target, not the exact path's 0.999 — the
+    # clustered rung's acceptance bar IS its measured recall target
+    # (DESIGN.md ladder rung 4); vs_baseline still zeroes on a miss.
+    ivf_partitions = os.environ.get("BENCH_IVF_PARTITIONS")
+    ivf_nprobe = os.environ.get("BENCH_IVF_NPROBE")
+    if ivf_nprobe and not ivf_partitions:
+        print(
+            json.dumps({
+                "error": "BENCH_IVF_NPROBE without BENCH_IVF_PARTITIONS: "
+                "nprobe selects how many of a clustered index's "
+                "partitions to scan — a probe count without partitions "
+                "would be silently ignored"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_partitions and backend != "serial":
+        print(
+            json.dumps({
+                "error": f"BENCH_IVF_PARTITIONS conflicts with "
+                f"BENCH_BACKEND={backend}: the clustered search is a "
+                "single-device serial-math path — an A/B sweep here would "
+                "record identical serial runs mislabeled as backend "
+                "variants"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_partitions and os.environ.get("BENCH_PRECISION"):
+        print(
+            json.dumps({
+                "error": "BENCH_PRECISION conflicts with "
+                "BENCH_IVF_PARTITIONS: the clustered search owns its dot "
+                "precisions (HIGHEST centroid score + rerank; DEFAULT "
+                "compress under BENCH_PRECISION_POLICY=mixed)"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_partitions and (
+        os.environ.get("BENCH_TOPK") or os.environ.get("BENCH_SCHEDULE")
+    ):
+        # the probed path always finishes with the exact rerank top-k and
+        # has no tile-merge schedule — a banked line whose metadata names
+        # a selection method / schedule that never ran would be a
+        # mislabeled measurement (the library refuses the same knobs)
+        print(
+            json.dumps({
+                "error": "BENCH_TOPK/BENCH_SCHEDULE conflict with "
+                "BENCH_IVF_PARTITIONS: the clustered search always "
+                "finishes with the exact rerank top-k and has no "
+                "tile-merge schedule — the knobs would be silently "
+                "ignored and the measurement mislabeled"
+            }),
+            file=sys.stderr,
+        )
+        return 2
     # BENCH_CENTER=0: skip mean-centering — read ONCE; the zero_eps pairing
     # below derives from the same bool so the two can never desync
     center = os.environ.get("BENCH_CENTER", "1") != "0"
@@ -159,17 +234,6 @@ def main() -> int:
         pallas_variant=os.environ.get("BENCH_PALLAS_VARIANT", "tiles"),
         recall_target=float(os.environ.get("BENCH_RT", "0.999")),
         dtype=os.environ.get("BENCH_DTYPE", "float32"),
-        # bench default HIGH (3-pass bf16): measured recall 1.0 on the
-        # integer-pixel corpus with ~4% median win over HIGHEST (r3 A/B,
-        # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
-        # its data; the library does not. BENCH_PRECISION overrides;
-        # BENCH_PRECISION_POLICY=mixed takes the knob over entirely (the
-        # conflicting combination was rejected above).
-        matmul_precision=(
-            None
-            if precision_policy == "mixed"
-            else os.environ.get("BENCH_PRECISION") or "high"
-        ),
         precision_policy=precision_policy,
         # BENCH_RING_XFER=bfloat16 halves ICI bytes per ring hop (the knob
         # only matters for BENCH_BACKEND=ring/ring-overlap)
@@ -184,35 +248,83 @@ def main() -> int:
         # neighbor distances (~1e5).
         center=center,
         zero_eps=0.0 if center else 64.0,
+        partitions=int(ivf_partitions) if ivf_partitions else None,
+        nprobe=int(ivf_nprobe) if ivf_nprobe else None,
+        # bench default HIGH (3-pass bf16): measured recall 1.0 on the
+        # integer-pixel corpus with ~4% median win over HIGHEST (r3 A/B,
+        # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
+        # its data; the library does not. BENCH_PRECISION overrides;
+        # BENCH_PRECISION_POLICY=mixed takes the knob over entirely and the
+        # ivf search path fixes its own dot precisions (both conflicting
+        # combinations were rejected above).
+        matmul_precision=None if (ivf_partitions or
+                                  precision_policy == "mixed")
+        else os.environ.get("BENCH_PRECISION") or "high",
     )
 
-    # data to device ONCE — the timed region is the all-kNN phase, matching
-    # the reference's timer placement
-    Xd = jax.device_put(jnp.asarray(X, dtype=jnp.dtype(cfg.dtype)))
-    device_sync(Xd)
+    if ivf_partitions:
+        from mpi_knn_tpu.ivf import build_ivf_index
+        from mpi_knn_tpu.ivf.search import (
+            prepare_query_tiles,
+            run_query_tiles,
+        )
 
-    # compile + warm up
-    result = all_knn(Xd, config=cfg)
-    device_sync(result.dists)
+        # index build (k-means train + nprobe tune) is the amortized
+        # half — outside the timed region, like the corpus upload below;
+        # the queries are likewise centered/padded/tiled and put on
+        # device ONCE, so the timed region is probe compute + sync only
+        # (the dense series' timer placement — a per-rep host centering
+        # pass would make the two series incomparable)
+        index = build_ivf_index(X, cfg)
+        rcfg = index.compatible_cfg(index.cfg)
+        qids = np.arange(m, dtype=np.int32)
+        q_tiles, qid_tiles, q_pad, _ = prepare_query_tiles(
+            index, X, qids, rcfg
+        )
+        device_sync(q_tiles)
+        d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)  # warm
+        device_sync(d, i)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)
+            device_sync(d, i)
+            times.append(time.perf_counter() - t0)
+        got_ids = np.asarray(
+            jax.device_get(i)
+        ).reshape(q_pad, rcfg.k)[:m]
+    else:
+        # data to device ONCE — the timed region is the all-kNN phase,
+        # matching the reference's timer placement
+        Xd = jax.device_put(jnp.asarray(X, dtype=jnp.dtype(cfg.dtype)))
+        device_sync(Xd)
 
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
+        # compile + warm up
         result = all_knn(Xd, config=cfg)
-        device_sync(result.dists, result.ids)
-        times.append(time.perf_counter() - t0)
+        device_sync(result.dists)
+
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            result = all_knn(Xd, config=cfg)
+            device_sync(result.dists, result.ids)
+            times.append(time.perf_counter() - t0)
     # median is the headline (VERDICT r1 #9): honest under transport noise;
     # min stays visible on stderr for best-case comparisons
     value = float(np.median(times))
 
     sample = np.linspace(0, m - 1, num=min(256, m), dtype=np.int64)
     want = oracle_topk(X, sample, k)
-    got = np.asarray(jax.device_get(result.ids[jnp.asarray(sample)]))
+    if ivf_partitions:
+        got = got_ids[sample]
+    else:
+        got = np.asarray(jax.device_get(result.ids[jnp.asarray(sample)]))
     recall = recall_at_k(got, want)
 
     n_chips = jax.local_device_count() if jax.default_backend() == "tpu" else 1
     target_here = NORTH_STAR_SECONDS * (NORTH_STAR_CHIPS / n_chips)
-    vs = (target_here / value) if recall >= RECALL_GATE else 0.0
+    gate = cfg.recall_target if ivf_partitions else RECALL_GATE
+    vs = (target_here / value) if recall >= gate else 0.0
 
     line = {
         "metric": metric_name(),
@@ -241,9 +353,11 @@ def main() -> int:
                 "chips": n_chips,
                 "platform": jax.default_backend(),
                 "target_seconds_at_this_chip_count": target_here,
-                "recall_gate": RECALL_GATE,
                 "topk_method": cfg.topk_method,
                 "precision_policy": cfg.precision_policy,
+                "partitions": cfg.partitions,
+                "nprobe": (index.nprobe if ivf_partitions else None),
+                "recall_gate": gate,
                 "merge_schedule": cfg.merge_schedule,
                 "tiles": [cfg.query_tile, cfg.corpus_tile],
             }
@@ -284,7 +398,8 @@ def _cpu_fallback_line():
     # backend would loudly refuse (their loud-exit-2 conflict checks are
     # correct for user runs — the fallback must not trip them)
     for k in ("BENCH_RING_SCHEDULE", "BENCH_RING_XFER",
-              "BENCH_PALLAS_VARIANT"):
+              "BENCH_PALLAS_VARIANT", "BENCH_IVF_PARTITIONS",
+              "BENCH_IVF_NPROBE"):
         env.pop(k, None)
     env.update(
         BENCH_PLATFORM="cpu",

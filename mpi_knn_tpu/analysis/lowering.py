@@ -32,7 +32,11 @@ from mpi_knn_tpu.config import BACKENDS, METRICS, KNNConfig
 STAGES = ("before_opt", "after_opt")
 LINT_DTYPES = ("float32", "bfloat16", "float64")
 LINT_POLICIES = ("exact", "mixed")
-LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
+# the dense (full-scan) backends sweep the whole metric × dtype product;
+# the clustered "ivf" cells are appended explicitly (l2/float32 only — the
+# IVF path's own contract) but share the CLI filter namespace
+DENSE_LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
+LINT_BACKENDS = DENSE_LINT_BACKENDS + ("ivf",)
 
 # Small but structurally faithful: 8 query tiles, 8 corpus tiles, an 8-way
 # ring with one (q_tile × c_tile) block tile per device per round — every
@@ -78,14 +82,14 @@ RING_BACKENDS = ("ring", "ring-overlap")
 def default_targets() -> list[LintTarget]:
     return [
         LintTarget(b, m, d)
-        for b in LINT_BACKENDS
+        for b in DENSE_LINT_BACKENDS
         for m in METRICS
         for d in LINT_DTYPES
     ] + [
         # the mixed compress-and-rerank policy: float32 only (config.py
         # validation), every backend × metric
         LintTarget(b, m, "float32", "mixed")
-        for b in LINT_BACKENDS
+        for b in DENSE_LINT_BACKENDS
         for m in METRICS
     ] + [
         # the bidirectional ring schedule: ring backends only, float32, both
@@ -105,9 +109,21 @@ def default_targets() -> list[LintTarget]:
         # memory, dtype and collective contracts must survive the serving
         # wrapper unchanged)
         LintTarget(b, "l2", "float32", serve=True)
-        for b in LINT_BACKENDS
+        for b in DENSE_LINT_BACKENDS
     ] + [
         LintTarget("serial", "l2", "float32", "mixed", serve=True),
+    ] + [
+        # the clustered (IVF) cells — one-shot and serve-cache forms, both
+        # policies: R6 certifies the probe-gather-feeds-the-only-exact-dot
+        # contract and R2 runs in STRICT mode (the probed-bytes bound
+        # nprobe·bucket_cap·d per query row replaces the largest-input
+        # floor, so a full-corpus materialization is a finding even though
+        # the whole corpus is a program input); the serve cells add R5's
+        # donation/no-corpus-copy contract on the bucket-cache program
+        LintTarget("ivf", "l2", "float32"),
+        LintTarget("ivf", "l2", "float32", "mixed"),
+        LintTarget("ivf", "l2", "float32", serve=True),
+        LintTarget("ivf", "l2", "float32", "mixed", serve=True),
     ]
 
 
@@ -305,6 +321,84 @@ def _lower_pallas(target: LintTarget):
     return lowered, cfg, meta
 
 
+# IVF lint shapes: 256 deterministic rows over 8 partitions probed at 2 —
+# balanced buckets hold ~32 rows, so the probed width v = nprobe·cap ≥ 64
+# keeps the mixed overfetch 4k=16 strictly narrower than v (the R3/R6
+# contracts stay non-vacuous) while the probe bound stays well under the
+# corpus (2/8 of it), making R2's strict budget a real claim.
+LINT_M_IVF, LINT_PARTITIONS, LINT_NPROBE = 256, 8, 2
+
+
+def _ivf_cfg(target: LintTarget) -> KNNConfig:
+    return KNNConfig(
+        k=LINT_K,
+        query_tile=LINT_QUERY_TILE,
+        precision_policy=target.policy,
+        partitions=LINT_PARTITIONS,
+        nprobe=LINT_NPROBE,
+        kmeans_iters=2,  # lint cares about the search program, not fit
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _ivf_lint_index(cfg: KNNConfig):
+    """One small trained IVFIndex per config — k-means on deterministic
+    rows (seeded rng), shared by the one-shot and serve cells."""
+    from mpi_knn_tpu.ivf import build_ivf_index
+
+    rng = np.random.default_rng(0)
+    data = (rng.standard_normal((LINT_M_IVF, LINT_D)) * 3).astype(np.float32)
+    return build_ivf_index(data, cfg)
+
+
+def _ivf_meta(index, cfg: KNNConfig, q_tile: int) -> dict:
+    v = cfg.nprobe * index.bucket_cap
+    return {
+        "q_tile": q_tile,
+        "c_tile": v,
+        "acc_bytes": 4,
+        "partitions": index.partitions,
+        "dim": index.dim,
+        # R2 STRICT mode: the probe gather is the declared budget — the
+        # program must not materialize beyond nprobe·bucket_cap·d per
+        # query row (the sublinear claim, machine-checked)
+        "budget_elems": q_tile * v * index.dim,
+    }
+
+
+def _lower_ivf(target: LintTarget):
+    from mpi_knn_tpu.ivf.search import _ivf_serve_jit, ivf_query_shapes
+    from mpi_knn_tpu.ops.topk import init_topk_tiles
+
+    if target.metric != "l2" or target.dtype != "float32":
+        raise UnsupportedTarget(
+            "the clustered (IVF) path is l2/float32 by its own contract "
+            "(ivf/index.py rejects other combinations)"
+        )
+    cfg = _ivf_cfg(target)
+    index = _ivf_lint_index(cfg)
+    cfg = index.compatible_cfg(cfg)
+    q_tile, q_pad = ivf_query_shapes(
+        cfg, cfg.nprobe, index.bucket_cap, index.dim, LINT_NQ
+    )
+    qt = q_pad // q_tile
+    carry_d, carry_i = init_topk_tiles(qt, q_tile, cfg.k, dtype=jnp.float32)
+    lowered = _ivf_serve_jit.lower(
+        jnp.zeros((qt, q_tile, index.dim), jnp.float32),
+        jnp.full((qt, q_tile), -1, jnp.int32),
+        carry_d,
+        carry_i,
+        index.centroids,
+        index.centroid_sqs,
+        index.buckets,
+        index.bucket_ids,
+        index.bucket_sqs,
+        cfg,
+        cfg.nprobe,
+    )
+    return lowered, cfg, _ivf_meta(index, cfg, q_tile)
+
+
 def _lower_serve(target: LintTarget):
     """Lower the serving engine's per-batch program for one cell through
     the PRODUCTION path: a real (small) CorpusIndex is built and
@@ -313,6 +407,28 @@ def _lower_serve(target: LintTarget):
     drift and certify a program nobody serves."""
     from mpi_knn_tpu.serve import build_index
     from mpi_knn_tpu.serve.engine import SCRATCH_PARAMS, lower_bucket
+
+    if target.backend == "ivf":
+        # the clustered index serves through the SAME bucket cache; its
+        # per-batch program is lowered via the production lower_bucket so
+        # R5's donation contract and R2/R6's probe discipline certify the
+        # exact executable the cache compiles
+        if target.metric != "l2" or target.dtype != "float32":
+            raise UnsupportedTarget(
+                "the clustered (IVF) path is l2/float32 by its own "
+                "contract (ivf/index.py rejects other combinations)"
+            )
+        cfg = _ivf_cfg(target).replace(query_bucket=LINT_NQ, donate=True)
+        index = _ivf_lint_index(_ivf_cfg(target))
+        cfg = index.compatible_cfg(cfg)
+        lowered, q_pad, q_tile = lower_bucket(index, cfg, LINT_NQ)
+        meta = {
+            **_ivf_meta(index, cfg, q_tile),
+            "serve": True,
+            "donated_params": SCRATCH_PARAMS if cfg.donate else (),
+            "resident_bytes": index.nbytes_resident,
+        }
+        return lowered, cfg, meta
 
     if target.backend == "pallas" and target.dtype != "float32":
         raise UnsupportedTarget(
@@ -360,6 +476,7 @@ _LOWERERS = {
     "ring": _lower_ring,
     "ring-overlap": _lower_ring,
     "pallas": _lower_pallas,
+    "ivf": _lower_ivf,
 }
 
 

@@ -30,6 +30,15 @@ Shipped rules:
   direction — wrong-direction or missing permutes are findings);
   single-device backends must contain no collectives at all (a stray
   ``all-gather`` / ``all-reduce`` is a sharding leak).
+- **R6-ivf-probe** — clustered-index probe discipline. In an IVF cell the
+  only way corpus payload may reach a dot is the per-query probe gather:
+  every batched candidate dot must carry a ``gather`` in its backward
+  slice (and at least one must exist — zero is a vacuous contract), and
+  no un-batched dot may be wider than the centroid score. Combined with
+  R2's strict probed-bytes budget (``budget_elems``: the gather bound
+  nprobe·bucket_cap·d per query row REPLACES the largest-input floor),
+  "sublinear per query" is a compiled-program fact, not a Python-side
+  counter.
 - **R5-donation** — donation/aliasing of the serving batch program. The
   per-batch executable the serving engine compiles (``mpi_knn_tpu.serve``)
   must declare its scratch donation in the module header (``buffer_donor``
@@ -312,6 +321,17 @@ class R2Memory(Rule):
     def applies(self, ctx) -> bool:
         return True
 
+    # strict-budget exemptions: opcodes that forward existing buffers
+    # rather than materialize new payload — loop/tuple plumbing (XLA
+    # aliases while state in place; tuple/gte are pointer shuffles). The
+    # resident corpus legitimately rides through the query-tile loop's
+    # state inside them; anything that COMPUTES corpus-sized bytes
+    # (gather, dot, broadcast, fusion, …) stays on the hook.
+    STRICT_EXEMPT = (
+        "parameter", "tuple", "get-tuple-element", "while", "opt-barrier",
+        "conditional", "call",
+    )
+
     def check(self, ctx, stage, module) -> list[Finding]:
         entry_params = [
             i
@@ -331,18 +351,38 @@ class R2Memory(Rule):
         # "extra_elems" is the lowering's registered legitimate intermediate
         # beyond the tile (today: the mixed policy's (q_tile, 4k, d) rerank
         # gather) — declared per configuration, never a blanket slack bump.
-        budget = max(
-            max_param,
-            R2_SLACK * tile_elems,
-            ctx.meta.get("extra_elems", 0),
-        ) * acc_bytes
+        #
+        # "budget_elems" switches R2 to the STRICT mode the clustered (IVF)
+        # cells use: the declared bound REPLACES the largest-input floor,
+        # so the budget is the probe gather (q_tile·nprobe·bucket_cap·d)
+        # and NOT the resident corpus — the lowering must prove it scans
+        # only probed partitions, with only non-materializing loop/tuple
+        # plumbing exempt.
+        strict = ctx.meta.get("budget_elems")
+        if strict is not None:
+            budget = max(strict, R2_SLACK * tile_elems) * acc_bytes
+        else:
+            budget = max(
+                max_param,
+                R2_SLACK * tile_elems,
+                ctx.meta.get("extra_elems", 0),
+            ) * acc_bytes
+        exempt = self.STRICT_EXEMPT if strict is not None else ("parameter",)
         out = []
         for c in module.computations.values():
             for i in c.instructions.values():
-                if i.opcode == "parameter":
-                    continue  # inputs are the caller's bytes, not new ones
+                if i.opcode in exempt:
+                    continue  # inputs/plumbing: the caller's bytes, not new
                 b = max_buffer_bytes(i.type_str)
                 if b > budget:
+                    why = (
+                        f"(declared probed-bytes bound {strict} elems, "
+                        "NOT the resident corpus"
+                        if strict is not None
+                        else f"(max(largest input {max_param} elems, "
+                        f"{R2_SLACK}×{ctx.meta['q_tile']}×"
+                        f"{ctx.meta['c_tile']} tile elems)"
+                    )
                     out.append(
                         Finding(
                             self.name,
@@ -350,10 +390,7 @@ class R2Memory(Rule):
                             stage,
                             f"{c.name}::{i.name} ({i.opcode}) materializes "
                             f"{b} bytes > budget {budget} "
-                            f"(max(largest input {max_param} elems, "
-                            f"{R2_SLACK}×{ctx.meta['q_tile']}×"
-                            f"{ctx.meta['c_tile']} tile elems) × {acc_bytes} "
-                            "acc bytes)",
+                            f"{why} × {acc_bytes} acc bytes)",
                             {
                                 "bytes": b,
                                 "budget": budget,
@@ -987,6 +1024,103 @@ class R4Collectives(Rule):
                     "ring program compiled to zero collective-permutes — "
                     "the rotation was optimized away (results can only be "
                     "correct if the corpus never moved, i.e. they are not)",
+                    {},
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R6: clustered-index probe discipline
+
+# a dot with a non-empty batch dimension list — the per-query candidate
+# form (q, d) × (q, v, d): the only legal way corpus payload reaches a dot
+# in an IVF program, because the batched candidate operand can only come
+# from the per-query probe gather
+_BATCH_DIMS_RE = re.compile(r"(?:lhs|rhs)_batch_dims=\{\s*\d")
+
+
+@register
+class R6IvfProbe(Rule):
+    name = "R6-ivf-probe"
+    description = (
+        "clustered (IVF) programs score corpus payload ONLY through the "
+        "probe gather: every batched candidate dot is fed by a gather, at "
+        "least one exists, and no un-batched dot is wider than the "
+        "centroid score — a full-corpus dot would bypass the partition "
+        "pruning the index exists for"
+    )
+
+    def applies(self, ctx) -> bool:
+        return getattr(ctx.target, "backend", None) == "ivf"
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        if stage != "before_opt":
+            # after optimization fusion legitimately rewrites dots and
+            # gathers into fusion computations; the declared dataflow is
+            # pinned on the module XLA receives (the R3-contract stance)
+            return []
+        out = []
+        n_batched = 0
+        # un-batched dots may only be the centroid score: operands are the
+        # (q_tile, d) query tile and the (partitions, d) routing table
+        allowed = (
+            max(ctx.meta.get("q_tile", 0), ctx.meta.get("partitions", 0))
+            * ctx.meta.get("dim", 0)
+        )
+        for c in module.computations.values():
+            for i in c.instructions.values():
+                if i.opcode != "dot":
+                    continue
+                if _BATCH_DIMS_RE.search(i.attrs):
+                    n_batched += 1
+                    sl = backward_slice(module, c.name, i.name)
+                    if "gather" not in slice_opcodes(module, sl):
+                        out.append(
+                            Finding(
+                                self.name,
+                                ctx.target.label,
+                                stage,
+                                f"{c.name}::{i.name} is a batched "
+                                "candidate dot with NO gather in its "
+                                "backward slice — it scores rows the "
+                                "probe never selected (the partition "
+                                "pruning is bypassed)",
+                                {"type": i.type_str},
+                            )
+                        )
+                elif allowed:
+                    op_elems = max(
+                        (
+                            max_buffer_elems(c.instructions[o].type_str)
+                            for o in i.operands
+                            if o in c.instructions
+                        ),
+                        default=0,
+                    )
+                    if op_elems > allowed:
+                        out.append(
+                            Finding(
+                                self.name,
+                                ctx.target.label,
+                                stage,
+                                f"{c.name}::{i.name} is an un-batched dot "
+                                f"over {op_elems} elems > the centroid "
+                                f"score bound {allowed} (max(q_tile, "
+                                "partitions)·d) — a full-corpus dot "
+                                "bypasses the partition pruning",
+                                {"elems": op_elems, "bound": allowed},
+                            )
+                        )
+        if n_batched == 0:
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.target.label,
+                    stage,
+                    "IVF program lowered NO batched candidate dot — the "
+                    "probe-gather contract is vacuous (nothing scores the "
+                    "gathered candidates exactly)",
                     {},
                 )
             )

@@ -12,6 +12,10 @@ Examples::
     python -m mpi_knn_tpu --data synthetic:2048x64c10 --backend ring-overlap
     python -m mpi_knn_tpu --data corpus.mat --svd 64 --k 10 --report out.json
     python -m mpi_knn_tpu query --data corpus.mat --queries q.npy  # serving
+    python -m mpi_knn_tpu build-index --data sift:100000 --partitions 256 \
+        --out sift.ivf.npz                       # clustered (IVF) index
+    python -m mpi_knn_tpu query --data sift:100000 --index-load sift.ivf.npz \
+        --synthetic 4096                         # sublinear serving
     python -m mpi_knn_tpu lint --serve                     # static analysis
 """
 
@@ -256,6 +260,13 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.serve.cli import main as query_main
 
         return query_main(argv[1:])
+    if argv and argv[0] == "build-index":
+        # clustered-index subcommand: train the k-means partitioner and
+        # save an IVF index (.npz) for `query --index-load`
+        # (mpi_knn_tpu.ivf). Same routing pattern as lint/query.
+        from mpi_knn_tpu.ivf.cli import main as build_index_main
+
+        return build_index_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.save_every is not None and args.save_every <= 0:

@@ -20,6 +20,7 @@ MERGE_SCHEDULES = ("stream", "twolevel")
 RING_SCHEDULES = ("uni", "bidir")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
+KMEANS_INITS = ("kmeans++", "random")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,6 +175,27 @@ class KNNConfig:
     # oldest unconsumed result: depth 2 overlaps batch t+1's H2D transfer
     # with batch t's compute (double buffering); 1 is fully synchronous.
     dispatch_depth: int = 2
+    # --- clustered (IVF) index knobs (mpi_knn_tpu.ivf) -------------------
+    # partitions: number of k-means partitions of a clustered index — the
+    # axis that makes per-query work SUBLINEAR in the corpus (TPU-KNN,
+    # arXiv 2206.14286): queries score `partitions` centroids, then scan
+    # only the `nprobe` nearest partitions with an exact rerank, so probed
+    # bytes per query are nprobe/partitions of the corpus instead of all
+    # of it. None = no clustering (every existing backend scans the full
+    # corpus; nothing changes).
+    partitions: Optional[int] = None
+    # partitions probed per query. None = auto-tune at index build: the
+    # smallest nprobe whose measured recall@k on a held-out corpus sample
+    # reaches `recall_target` against the brute-force (nprobe=partitions)
+    # oracle. nprobe == partitions degenerates to an exact full scan.
+    nprobe: Optional[int] = None
+    # k-means training knobs (ivf/kmeans.py): a FIXED Lloyd iteration count
+    # (static scan length — the whole trainer lowers to one executable),
+    # init scheme, and the PRNG seed threaded through init and any
+    # re-seeding so training is bit-deterministic per seed.
+    kmeans_iters: int = 25
+    kmeans_init: str = "kmeans++"
+    ivf_seed: int = 0
     # donate the per-batch top-k scratch to the serving executable
     # (donate_argnums): XLA aliases the scratch buffers to the outputs
     # (machine-checked from the module's input_output_alias by lint rule
@@ -241,6 +263,37 @@ class KNNConfig:
         if self.dispatch_depth < 1:
             raise ValueError(
                 f"dispatch_depth must be >= 1, got {self.dispatch_depth}"
+            )
+        if self.kmeans_init not in KMEANS_INITS:
+            raise ValueError(
+                f"kmeans_init must be one of {KMEANS_INITS}, got "
+                f"{self.kmeans_init!r}"
+            )
+        if self.partitions is not None and self.partitions < 1:
+            raise ValueError(
+                f"partitions must be >= 1, got {self.partitions}"
+            )
+        if self.nprobe is not None:
+            if self.partitions is None:
+                raise ValueError(
+                    "nprobe without partitions is meaningless: nprobe "
+                    "selects how many of the clustered index's partitions "
+                    "to scan — set partitions too"
+                )
+            if not 1 <= self.nprobe <= self.partitions:
+                raise ValueError(
+                    f"nprobe must be in [1, partitions={self.partitions}], "
+                    f"got {self.nprobe}"
+                )
+        if self.partitions is not None and self.metric != "l2":
+            raise ValueError(
+                "a clustered (IVF) index supports metric='l2' only: the "
+                "k-means partitioner and the centroid score are L2 "
+                f"geometry (got metric={self.metric!r})"
+            )
+        if self.kmeans_iters < 1:
+            raise ValueError(
+                f"kmeans_iters must be >= 1, got {self.kmeans_iters}"
             )
         if self.topk_block < 1:
             raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")

@@ -1,0 +1,417 @@
+"""Clustered (IVF) corpus index: k-means partitions, padded per-cluster
+buckets, recall-targeted probe count.
+
+Layout (all device-resident after build):
+
+- ``centroids (P, d)`` f32 + their squared norms — the routing table;
+- ``buckets (P, bucket_cap, d)`` — every partition's rows, padded to one
+  static ``bucket_cap`` (max cluster size, lane-aligned) so the probe
+  gather is shape-static; padding slots carry id −1 and the standard
+  ``mask_tile`` semantics make them +inf candidates, never answers;
+- ``bucket_ids (P, bucket_cap)`` int32 global ids;
+- ``bucket_sqs (P, bucket_cap)`` squared norms, computed UNDER JIT from
+  the at-rest buckets (the serve-index precedent: eager reductions
+  produce different bits than traced ones, and the degenerate
+  nprobe == partitions scan is parity-tested against the serial backend).
+
+``dtype="bfloat16"`` stores buckets compressed at rest (half the HBM and
+half the probe-gather bytes; candidates upcast to f32 after the gather) —
+the same measured-recall contract as the compressed serve index.
+
+``nprobe`` auto-tuning: when the build config leaves ``nprobe=None``, a
+held-out corpus sample is searched at doubling nprobe values and compared
+against the brute-force oracle (``nprobe == partitions`` — the exact full
+scan through the same program, so the measured number is pure partition-
+pruning loss, no cross-program fp noise); the smallest nprobe reaching
+``cfg.recall_target`` becomes the index default.
+
+``save``/``load`` round-trip the whole index through one ``.npz``
+bit-identically (bf16 buckets travel as uint16 views — numpy has no
+native bfloat16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.ivf.kmeans import kmeans
+from mpi_knn_tpu.ivf.search import search_ivf
+from mpi_knn_tpu.ops.distance import sq_norms
+from mpi_knn_tpu.parallel.partition import pad_to_multiple
+
+# held-out sample size for recall-targeted nprobe tuning (the CLI/bench
+# recall-gate convention: enough rows for a stable estimate, cheap enough
+# to run at build time)
+TUNE_SAMPLE = 256
+IVF_DTYPES = ("float32", "bfloat16")
+
+
+@dataclasses.dataclass
+class IVFIndex:
+    """Resident clustered-index state for one (corpus, config) pair.
+
+    Duck-types the corner of ``serve.CorpusIndex`` the serving engine
+    touches (``backend``/``cfg``/``mu``/``m``/``dim``/``_cache``/
+    ``compatible_cfg``/``nbytes_resident``), so the bucketed AOT
+    executable cache, ``ServeSession`` and ``api.query_knn`` serve it
+    unchanged.
+    """
+
+    cfg: KNNConfig  # resolved: backend="serial", concrete nprobe
+    m: int
+    dim: int
+    partitions: int
+    bucket_cap: int
+    nprobe: int  # index default (tuned or configured)
+    mu: object | None  # centering mean (host f64), or None
+    centroids: jax.Array  # (P, d) f32
+    centroid_sqs: jax.Array  # (P,)
+    buckets: jax.Array  # (P, cap, d) at-rest dtype
+    bucket_ids: jax.Array  # (P, cap) int32
+    bucket_sqs: jax.Array  # (P, cap) f32
+    tuned_recall: float | None = None  # measured recall@k at `nprobe`
+    backend: str = "ivf"
+    # per-index executable cache: {(bucket, cfg) -> engine._BucketExec}
+    _cache: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def nbytes_resident(self) -> int:
+        """Bytes of resident corpus payload (the bucket store)."""
+        return self.buckets.size * self.buckets.dtype.itemsize
+
+    @property
+    def probe_bytes(self) -> int:
+        """Bytes one query row's probe gather touches at the index-default
+        nprobe — the sublinear bound (≤ nprobe·bucket_bytes, never the
+        corpus) that lint rule R2 budgets on the lowered program."""
+        return (
+            self.nprobe * self.bucket_cap * self.dim
+            * self.buckets.dtype.itemsize
+        )
+
+    def compatible_cfg(self, cfg: KNNConfig) -> KNNConfig:
+        """Validate a per-query config against the build-time clustering.
+
+        Query-side knobs (k, nprobe, precision policy, tiling, serving
+        pacing, donation) may vary per call — the executable cache keys
+        on the full config. Corpus-side knobs (metric, dtype, partitions,
+        the k-means training knobs, centering, zero-exclusion) are baked
+        into the trained partitions and may NOT vary. A ``nprobe=None``
+        query config resolves to the index's tuned default.
+        """
+        frozen = (
+            "backend", "metric", "dtype", "partitions", "kmeans_iters",
+            "kmeans_init", "ivf_seed", "center", "exclude_zero", "zero_eps",
+        )
+        want = cfg if cfg.backend != "auto" else cfg.replace(backend="serial")
+        bad = [
+            f for f in frozen
+            if getattr(want, f) != getattr(self.cfg, f)
+        ]
+        if bad:
+            raise ValueError(
+                "query config changes corpus-side knobs baked into this "
+                f"clustered index: {bad}; build a new index (or override "
+                "only query-side knobs: k/nprobe/precision_policy/"
+                "query_tile/query_bucket/dispatch_depth/donate)"
+            )
+        _refuse_inert_knobs(want)
+        if want.nprobe is None:
+            want = want.replace(nprobe=self.nprobe)
+        return want
+
+
+def _refuse_inert_knobs(cfg: KNNConfig) -> None:
+    """Knobs the clustered search cannot honor are refused LOUDLY, never
+    silently ignored (the serve-CLI/bench convention): the probed
+    candidates always finish with the exact rerank top-k, and the
+    centroid-score/rerank dots fix their own precisions — a config (or a
+    banked measurement's metadata) claiming otherwise would be lying
+    about the program that ran."""
+    if cfg.topk_method != "exact":
+        raise ValueError(
+            f"topk_method={cfg.topk_method!r} cannot be honored by the "
+            "clustered (IVF) search: the probed-candidate finish is "
+            "always the exact rerank top-k (ops/rerank.rerank_exact_topk)"
+            " — unset it, or use a dense backend for approximate "
+            "selection"
+        )
+    if cfg.matmul_precision is not None:
+        raise ValueError(
+            f"matmul_precision={cfg.matmul_precision!r} cannot be "
+            "honored by the clustered (IVF) search: it fixes its own dot "
+            "precisions (HIGHEST centroid score + rerank; DEFAULT "
+            "compress under precision_policy='mixed')"
+        )
+    if cfg.merge_schedule != "twolevel":
+        raise ValueError(
+            f"merge_schedule={cfg.merge_schedule!r} cannot be honored by "
+            "the clustered (IVF) search: there is no tile-merge schedule "
+            "on the probed path (one gather, one exact finish) — leave "
+            "it at the default"
+        )
+
+
+def _corpus_from_serve_index(serve_index):
+    """Centered corpus rows + mean back out of a serial-layout
+    ``serve.CorpusIndex`` (the tile stack is the corpus, padded — strip
+    the sentinel rows)."""
+    if serve_index.tiles is None:
+        raise ValueError(
+            "an IVF index can only be built from a serial-layout "
+            "CorpusIndex (tiles resident on one device); the "
+            f"{serve_index.backend!r} layout shards or fuses the corpus"
+        )
+    rows = np.asarray(serve_index.tiles, dtype=np.float32).reshape(
+        -1, serve_index.dim
+    )[: serve_index.m]
+    return rows, serve_index.mu, serve_index.cfg
+
+
+def build_ivf_index(
+    corpus,
+    config: Optional[KNNConfig] = None,
+    **overrides,
+) -> IVFIndex:
+    """Train the k-means partitioner and build a device-resident
+    :class:`IVFIndex`.
+
+    Args:
+      corpus: (m, d) host/device array, or an existing serial-layout
+        ``serve.CorpusIndex`` (its centered resident tiles are reused;
+        no second centering pass).
+      config: build-time :class:`KNNConfig` with ``partitions`` set;
+        kwargs override fields. ``nprobe=None`` triggers the
+        recall-targeted auto-tune.
+    """
+    from mpi_knn_tpu.serve.index import CorpusIndex
+
+    cfg = (config or KNNConfig()).replace(**overrides)
+    if cfg.partitions is None:
+        raise ValueError(
+            "building a clustered index requires partitions "
+            "(KNNConfig.partitions / --partitions)"
+        )
+    if cfg.backend not in ("auto", "serial"):
+        raise ValueError(
+            f"the clustered index is a single-device serial-math path; "
+            f"backend={cfg.backend!r} cannot honor it (the pallas kernels "
+            "and the ring rotation scan the full corpus by construction) "
+            "— use backend='serial' or 'auto'"
+        )
+    if cfg.dtype not in IVF_DTYPES:
+        raise ValueError(
+            f"clustered index dtype must be one of {IVF_DTYPES} (float64 "
+            f"is the dense backends' debug mode), got {cfg.dtype!r}"
+        )
+    _refuse_inert_knobs(cfg)
+    cfg = cfg.replace(backend="serial")
+
+    mu = None
+    if isinstance(corpus, CorpusIndex):
+        rows, mu, built_cfg = _corpus_from_serve_index(corpus)
+        for f in ("metric", "dtype", "center"):
+            if getattr(built_cfg, f) != getattr(cfg, f):
+                raise ValueError(
+                    f"IVF config {f}={getattr(cfg, f)!r} disagrees with "
+                    f"the source CorpusIndex ({getattr(built_cfg, f)!r})"
+                )
+        X = rows  # already centered at serve-index build time
+    else:
+        X = np.asarray(
+            corpus if not isinstance(corpus, jax.Array)
+            else jax.device_get(corpus),
+            dtype=np.float32,
+        )
+        if cfg.center:
+            mu = X.astype(np.float64).mean(axis=0)
+            X = X - mu
+    m, dim = X.shape
+    if cfg.partitions > m:
+        raise ValueError(
+            f"partitions={cfg.partitions} exceeds the corpus rows ({m})"
+        )
+
+    res = kmeans(
+        X, cfg.partitions, iters=cfg.kmeans_iters, seed=cfg.ivf_seed,
+        init=cfg.kmeans_init,
+    )
+    assign = np.asarray(res.assignments)
+    counts = np.asarray(res.counts)
+    P = cfg.partitions
+    cap = pad_to_multiple(max(int(counts.max()), 1), 8)
+
+    buckets_np = np.zeros((P, cap, dim), dtype=np.float32)
+    ids_np = np.full((P, cap), -1, dtype=np.int32)
+    # vectorized scatter: rows sorted by cluster, each row's slot is its
+    # rank within its cluster (searchsorted finds the cluster's start) —
+    # a per-row Python loop here would make SIFT-scale builds
+    # interpreter-bound
+    order = np.argsort(assign, kind="stable")
+    sa = assign[order]
+    within = np.arange(m) - np.searchsorted(sa, sa)
+    buckets_np[sa, within] = X[order]
+    ids_np[sa, within] = order
+
+    dtype = jnp.dtype(cfg.dtype)
+    buckets = jnp.asarray(buckets_np).astype(dtype)
+    bucket_ids = jnp.asarray(ids_np)
+    # norms from the AT-REST buckets, under jit (bit-parity with the
+    # serial serve index's norm construction)
+    bucket_sqs = jax.jit(jax.vmap(sq_norms))(buckets)
+    centroids = res.centroids
+    centroid_sqs = jax.jit(sq_norms)(centroids)
+
+    index = IVFIndex(
+        cfg=cfg, m=m, dim=dim, partitions=P, bucket_cap=cap,
+        nprobe=cfg.nprobe or P, mu=mu,
+        centroids=centroids, centroid_sqs=centroid_sqs,
+        buckets=buckets, bucket_ids=bucket_ids, bucket_sqs=bucket_sqs,
+    )
+    if cfg.nprobe is None:
+        tuned, rec = tune_nprobe(index, cfg.recall_target, k=cfg.k)
+        index.nprobe = tuned
+        index.tuned_recall = rec
+        index.cfg = cfg.replace(nprobe=tuned)
+    else:
+        index.cfg = cfg
+    return index
+
+
+def tune_nprobe(
+    index: IVFIndex, recall_target: float, k: int = 10,
+    sample: int = TUNE_SAMPLE,
+) -> tuple[int, float]:
+    """Smallest nprobe whose measured recall@k on a held-out corpus
+    sample reaches ``recall_target`` against the brute-force oracle —
+    which is the SAME search program at ``nprobe == partitions`` (an
+    exact full scan), so the measurement isolates partition-pruning loss
+    from every other fp effect. Returns (nprobe, measured_recall)."""
+    from mpi_knn_tpu.utils.report import recall_at_k
+
+    P = index.partitions
+    ns = min(sample, index.m)
+    rows = np.linspace(0, index.m - 1, num=ns, dtype=np.int64)
+    # held-out queries are corpus rows WITH their identities, so
+    # self-exclusion matches the all-pairs workload the gate mirrors;
+    # they come back out of the bucket store (already centered). Only the
+    # sampled rows are gathered ON DEVICE — fetching/decompressing the
+    # whole store to host for ≤ TUNE_SAMPLE rows would move hundreds of
+    # MB at the corpus scales the index targets.
+    flat_ids = np.asarray(index.bucket_ids).reshape(-1)
+    pos_of = np.full(index.m, -1, dtype=np.int64)
+    valid = flat_ids >= 0
+    pos_of[flat_ids[valid]] = np.flatnonzero(valid)
+    Q = np.asarray(
+        index.buckets.reshape(-1, index.dim)[
+            jnp.asarray(pos_of[rows])
+        ].astype(jnp.float32)
+    )
+    qids = rows.astype(np.int32)
+
+    base_cfg = index.cfg.replace(nprobe=P, k=k)
+    _, want = search_ivf(
+        index, Q, query_ids=qids, config=base_cfg, assume_centered=True
+    )
+
+    def recall_at(n: int) -> float:
+        _, got = search_ivf(
+            index, Q, query_ids=qids,
+            config=index.cfg.replace(nprobe=n, k=k), assume_centered=True,
+        )
+        return float(recall_at_k(got, want))
+
+    # doubling walk to bracket the target, then a binary refinement so
+    # the result is the SMALLEST passing nprobe (the documented
+    # contract), not the smallest passing power of two — a power-of-two
+    # answer can probe up to ~2x the bytes the contract promises
+    lo, hi, hi_rec = 0, P, 1.0
+    n = 1
+    while n < P:
+        rec = recall_at(n)
+        if rec >= recall_target:
+            hi, hi_rec = n, rec
+            break
+        lo = n
+        n = min(2 * n, P)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        rec = recall_at(mid)
+        if rec >= recall_target:
+            hi, hi_rec = mid, rec
+        else:
+            lo = mid
+    return hi, hi_rec
+
+
+def save_ivf_index(index: IVFIndex, path: str) -> str:
+    """Write the full index to one ``.npz`` (bit-identical round trip;
+    bf16 buckets travel as uint16 views). Returns the path written."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    buckets = np.asarray(index.buckets)
+    bf16 = index.buckets.dtype == jnp.bfloat16
+    if bf16:
+        buckets = buckets.view(np.uint16)
+    meta = {
+        "cfg": {
+            k: v for k, v in dataclasses.asdict(index.cfg).items()
+        },
+        "m": index.m,
+        "dim": index.dim,
+        "partitions": index.partitions,
+        "bucket_cap": index.bucket_cap,
+        "nprobe": index.nprobe,
+        "tuned_recall": index.tuned_recall,
+        "buckets_bf16": bf16,
+        "has_mu": index.mu is not None,
+    }
+    np.savez(
+        path,
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        centroids=np.asarray(index.centroids),
+        centroid_sqs=np.asarray(index.centroid_sqs),
+        buckets=buckets,
+        bucket_ids=np.asarray(index.bucket_ids),
+        bucket_sqs=np.asarray(index.bucket_sqs),
+        mu=(np.asarray(index.mu)
+            if index.mu is not None else np.zeros(0)),
+    )
+    return path
+
+
+def load_ivf_index(path: str) -> IVFIndex:
+    """Reload a :func:`save_ivf_index` ``.npz`` — arrays land back on
+    device bit-identically; the executable cache starts empty."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        cfg = KNNConfig(**meta["cfg"])
+        buckets = z["buckets"]
+        if meta["buckets_bf16"]:
+            import ml_dtypes  # jax dependency; numpy has no native bf16
+
+            buckets = jnp.asarray(buckets.view(ml_dtypes.bfloat16))
+        else:
+            buckets = jnp.asarray(buckets)
+        return IVFIndex(
+            cfg=cfg,
+            m=meta["m"],
+            dim=meta["dim"],
+            partitions=meta["partitions"],
+            bucket_cap=meta["bucket_cap"],
+            nprobe=meta["nprobe"],
+            tuned_recall=meta["tuned_recall"],
+            mu=z["mu"] if meta["has_mu"] else None,
+            centroids=jnp.asarray(z["centroids"]),
+            centroid_sqs=jnp.asarray(z["centroid_sqs"]),
+            buckets=buckets,
+            bucket_ids=jnp.asarray(z["bucket_ids"]),
+            bucket_sqs=jnp.asarray(z["bucket_sqs"]),
+        )

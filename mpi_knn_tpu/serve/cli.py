@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "*.fvecs/bvecs, or a .mat file)")
     d.add_argument("--limit", type=int, default=None,
                    help="use first N corpus rows only")
+    d.add_argument("--index-load", default=None, metavar="PATH.npz",
+                   help="serve a saved clustered (IVF) index "
+                   "(`mpi-knn build-index`) instead of building a dense "
+                   "CorpusIndex from --data; --data is then only the "
+                   "source of --synthetic query statistics")
+    d.add_argument("--nprobe", type=int, default=None,
+                   help="with --index-load: partitions probed per query "
+                   "(default: the index's tuned value)")
     q = p.add_mutually_exclusive_group()
     q.add_argument("--queries", default=None,
                    help=".npy/.mat/.fvecs file of query points, streamed "
@@ -74,12 +82,18 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--backend", choices=BACKENDS, default="auto")
     k.add_argument("--devices", type=int, default=None,
                    help="ring size for distributed backends")
-    k.add_argument("--dtype", default="float32",
+    # corpus-side knobs default to None so --index-load can tell an
+    # explicitly passed flag (refused loudly if it conflicts with the
+    # saved index) from an untouched default; the dense build path
+    # resolves None to the documented defaults below
+    k.add_argument("--dtype", default=None,
                    choices=["float32", "bfloat16", "float64"],
-                   help="resident/compute dtype; bfloat16 stores the "
-                   "index compressed at half width")
+                   help="resident/compute dtype (default float32); "
+                   "bfloat16 stores the index compressed at half width")
     k.add_argument("--query-tile", type=int, default=1024)
-    k.add_argument("--corpus-tile", type=int, default=2048)
+    k.add_argument("--corpus-tile", type=int, default=None,
+                   help="corpus tile rows (default 2048); baked into a "
+                   "loaded index's layout")
     k.add_argument("--precision-policy", choices=list(PRECISION_POLICIES),
                    default="exact")
     k.add_argument("--topk-method", choices=list(TOPK_METHODS),
@@ -87,7 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--merge-schedule", choices=list(MERGE_SCHEDULES),
                    default="twolevel")
     k.add_argument("--ring-schedule", choices=list(RING_SCHEDULES),
-                   default="uni")
+                   default=None,
+                   help="ring rotation schedule (default uni); "
+                   "meaningless for a loaded clustered index")
     k.add_argument("--bucket", type=int, default=1024,
                    help="base row bucket: batches pad to bucket*2^j rows "
                    "and each (bucket, config) compiles exactly once")
@@ -161,18 +177,28 @@ def main(argv=None) -> int:
 
     X, _, source = load_corpus(args.data, limit=args.limit)
 
+    if args.index_load:
+        return _serve_loaded_index(args, X, source)
+
+    if args.nprobe is not None:
+        # the serve-CLI refusal convention: a probe count without a
+        # clustered index would be silently ignored
+        print("error: --nprobe requires --index-load (probing is a "
+              "clustered-index knob)", file=sys.stderr)
+        return 2
+
     try:
         cfg = KNNConfig(
             k=args.k,
             metric=args.metric,
             backend=args.backend,
-            dtype=args.dtype,
+            dtype=args.dtype or "float32",
             query_tile=args.query_tile,
-            corpus_tile=args.corpus_tile,
+            corpus_tile=args.corpus_tile or 2048,
             precision_policy=args.precision_policy,
             topk_method=args.topk_method,
             merge_schedule=args.merge_schedule,
-            ring_schedule=args.ring_schedule,
+            ring_schedule=args.ring_schedule or "uni",
             num_devices=args.devices,
             query_bucket=args.bucket,
             dispatch_depth=args.dispatch_depth,
@@ -194,7 +220,100 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     build_s = time.perf_counter() - t_build0
+    return _stream_and_report(args, session, index, X, source, build_s)
 
+
+def _serve_loaded_index(args, X, source) -> int:
+    """``--index-load``: serve a saved clustered (IVF) index through the
+    same session/bucket-cache machinery. Corpus-side knobs come from the
+    saved index; explicitly conflicting flags are refused with the
+    standard loud exit 2 (never silently serve a different configuration
+    than the one requested)."""
+    from mpi_knn_tpu.ivf import load_ivf_index
+    from mpi_knn_tpu.serve import ServeSession
+
+    if args.backend not in ("auto", "serial"):
+        print(
+            f"error: --index-load serves a clustered (IVF) index — a "
+            f"single-device serial-math path; --backend {args.backend} "
+            "cannot honor it (the pallas kernels and the ring rotation "
+            "scan the full corpus by construction)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.metric != "l2":
+        print(
+            f"error: --index-load × --metric {args.metric} is not "
+            "supported: the clustered index's k-means partitions and "
+            "centroid score are L2 geometry",
+            file=sys.stderr,
+        )
+        return 2
+    if args.devices is not None:
+        print("error: --devices has no meaning with --index-load (the "
+              "clustered search is single-device)", file=sys.stderr)
+        return 2
+    if args.corpus_tile is not None:
+        print("error: --corpus-tile has no meaning with --index-load "
+              "(the bucket layout was baked in at build time)",
+              file=sys.stderr)
+        return 2
+    if args.ring_schedule is not None:
+        print("error: --ring-schedule has no meaning with --index-load "
+              "(the clustered search never rotates a ring)",
+              file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    try:
+        index = load_ivf_index(args.index_load)
+    except (OSError, KeyError, ValueError) as e:
+        print(f"error: cannot load index {args.index_load!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.dtype is not None and args.dtype != index.cfg.dtype:
+        print(
+            f"error: --dtype {args.dtype} conflicts with the loaded "
+            f"index's at-rest dtype ({index.cfg.dtype}); the dtype is "
+            "baked in at build time",
+            file=sys.stderr,
+        )
+        return 2
+    if X.shape[1] != index.dim:
+        print(
+            f"error: --data {args.data!r} has dim {X.shape[1]} but the "
+            f"loaded index was built at dim {index.dim}",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        cfg = index.compatible_cfg(
+            index.cfg.replace(
+                k=args.k,
+                query_tile=args.query_tile,
+                precision_policy=args.precision_policy,
+                topk_method=args.topk_method,
+                merge_schedule=args.merge_schedule,
+                nprobe=args.nprobe,  # None -> the index's tuned default
+                query_bucket=args.bucket,
+                dispatch_depth=args.dispatch_depth,
+                donate=not args.no_donate,
+            )
+        )
+        session = ServeSession(index, cfg)
+    except ValueError as e:
+        # unhonorable combination (nprobe > partitions, mixed policy on a
+        # bf16-at-rest index, …)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    load_s = time.perf_counter() - t0
+    return _stream_and_report(args, session, index, X, source, load_s)
+
+
+def _stream_and_report(args, session, index, X, source, build_s) -> int:
+    """Shared serving tail: stream the query batches, print per-batch
+    latency lines, emit the summary/report."""
+    cfg = session.cfg
     total, stream = _load_query_stream(args, X)
 
     t0 = time.perf_counter()
@@ -226,6 +345,12 @@ def main(argv=None) -> int:
         "latency_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
         if len(lats) else None,
     }
+    if index.backend == "ivf":
+        summary["partitions"] = index.partitions
+        summary["nprobe"] = cfg.nprobe
+        summary["probe_fraction"] = round(
+            cfg.nprobe / index.partitions, 4
+        )
     if not args.quiet:
         print(
             f"[mpi-knn query] {summary['queries']} queries in "

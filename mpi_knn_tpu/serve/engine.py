@@ -122,6 +122,12 @@ def _pallas_jits():
     )
 
 
+def _ivf_jits():
+    from mpi_knn_tpu.ivf.search import ivf_serve_chunk
+
+    return _make_jits(ivf_serve_chunk, ("cfg", "nprobe"))
+
+
 @functools.lru_cache(maxsize=None)
 def _jits(backend: str):
     if backend == "serial":
@@ -130,6 +136,8 @@ def _jits(backend: str):
         return _ring_jits()
     if backend == "pallas":
         return _pallas_jits()
+    if backend == "ivf":
+        return _ivf_jits()
     raise ValueError(f"no serving path for backend {backend!r}")
 
 
@@ -250,11 +258,42 @@ def _pallas_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
     return lowered, q_pad, q_tile
 
 
+def _ivf_lowered(index, cfg: KNNConfig, bucket: int):
+    """Per-batch program for a clustered (IVF) index — same tiled layout
+    and scratch-donation convention as the serial cell, with the resident
+    arrays being the centroid table and the padded bucket store
+    (``mpi_knn_tpu.ivf``). ``cfg.nprobe`` is concrete here
+    (``IVFIndex.compatible_cfg`` resolves None to the tuned default)."""
+    from mpi_knn_tpu.ivf.search import ivf_query_shapes
+
+    nprobe = cfg.nprobe
+    q_tile, q_pad = ivf_query_shapes(
+        cfg, nprobe, index.bucket_cap, index.dim, bucket
+    )
+    qt = q_pad // q_tile
+    sds = jax.ShapeDtypeStruct
+    lowered = _jits("ivf")[cfg.donate].lower(
+        sds((qt, q_tile, index.dim), jnp.float32),
+        sds((qt, q_tile), jnp.int32),
+        sds((qt, q_tile, cfg.k), jnp.float32),
+        sds((qt, q_tile, cfg.k), jnp.int32),
+        index.centroids,
+        index.centroid_sqs,
+        index.buckets,
+        index.bucket_ids,
+        index.bucket_sqs,
+        cfg,
+        nprobe,
+    )
+    return lowered, q_pad, q_tile
+
+
 _LOWER_BUILDERS = {
     "serial": _serial_lowered,
     "ring": _ring_lowered,
     "ring-overlap": _ring_lowered,
     "pallas": _pallas_lowered,
+    "ivf": _ivf_lowered,
 }
 
 
@@ -335,7 +374,12 @@ def _prep_queries(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q):
             f"batch of {rows} rows exceeds the executable's bucket "
             f"({exec_.q_pad} padded rows)"
         )
-    dtype = jnp.dtype(cfg.dtype)
+    # an IVF index's dtype is the bucket store's AT-REST width; its search
+    # computes (and takes queries) in f32 — bf16-rounding the queries here
+    # would silently change the math vs the one-shot search_ivf path
+    dtype = (
+        jnp.float32 if exec_.backend == "ivf" else jnp.dtype(cfg.dtype)
+    )
     on_device = isinstance(q, jax.Array)
     if cfg.center and cfg.metric == "l2" and index.mu is not None:
         # same op order as all_knn's center_for_l2 on each residency, so
@@ -377,6 +421,23 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             index.tiles,
             index.tile_ids,
             index.tile_sqs,
+        )
+        return d.reshape(exec_.q_pad, cfg.k), i.reshape(exec_.q_pad, cfg.k)
+    if exec_.backend == "ivf":
+        qt = exec_.q_pad // exec_.q_tile
+        carry_d, carry_i = init_topk_tiles(
+            qt, exec_.q_tile, cfg.k, dtype=jnp.float32
+        )
+        d, i = exec_.compiled(
+            q2d.reshape(qt, exec_.q_tile, index.dim),
+            qids.reshape(qt, exec_.q_tile),
+            carry_d,
+            carry_i,
+            index.centroids,
+            index.centroid_sqs,
+            index.buckets,
+            index.bucket_ids,
+            index.bucket_sqs,
         )
         return d.reshape(exec_.q_pad, cfg.k), i.reshape(exec_.q_pad, cfg.k)
     if exec_.backend in ("ring", "ring-overlap"):

@@ -58,8 +58,13 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # (every backend's per-batch program from the bucketed executable cache,
 # `--serve` to run them alone), where R5 certifies the scratch donation
 # (every output aliased to a donated input in the compiled program) and
-# that nothing copies the resident corpus per batch; any finding fails
-# the gate
+# that nothing copies the resident corpus per batch — PLUS the clustered
+# (IVF) cells (`--backend ivf` to run them alone: one-shot + serve ×
+# exact/mixed over a real k-means-trained index), where R6 certifies
+# that corpus payload reaches a dot only through the per-query probe
+# gather and R2 runs in STRICT mode (the probed-bytes bound
+# nprobe·bucket_cap·d replaces the largest-input floor — the sublinear
+# claim as a compiled-program fact); any finding fails the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
 
 note "tier-1 pytest (the ROADMAP.md gate)"

@@ -47,6 +47,45 @@ def oracle_all_knn(
     return dists, ids
 
 
+def recall_against_oracle(
+    got_ids: np.ndarray,
+    oracle_dists: np.ndarray,
+    oracle_ids: np.ndarray,
+    k: int,
+) -> float:
+    """Tie-aware recall@k of retrieved ids against the f64 oracle.
+
+    A retrieved id counts as a hit if its oracle distance is within the
+    oracle's k-th distance — so when several candidates TIE at the top-k
+    boundary, any tied member is as correct as any other (a backend that
+    legitimately breaks the tie differently must not be scored as a
+    miss). The oracle arrays may carry MORE than k columns; passing a
+    wider oracle (e.g. ``oracle_all_knn(X, k=k + margin)``) widens the
+    visible tie cohort at the boundary. With exactly k columns this
+    degenerates to plain set-intersection recall (the historical
+    ``test_mixed_precision._recall``).
+
+    Invalid oracle slots (id −1 / +inf distance: fewer than k valid
+    neighbors exist) shrink the denominator — recall is over neighbors
+    the oracle could actually produce.
+    """
+    got = np.asarray(got_ids)[:, :k]
+    od = np.asarray(oracle_dists)
+    oi = np.asarray(oracle_ids)
+    total = 0.0
+    rows = 0
+    for r in range(got.shape[0]):
+        valid = oi[r] >= 0
+        n_valid = min(k, int(valid.sum()))
+        if n_valid == 0:
+            continue
+        thresh = od[r, n_valid - 1]
+        want = set(oi[r][valid & (od[r] <= thresh)].tolist())
+        total += len(set(got[r].tolist()) & want) / n_valid
+        rows += 1
+    return total / max(rows, 1)
+
+
 def oracle_vote_quirk(counts: np.ndarray, cmp_j: np.ndarray) -> np.ndarray:
     """Literal python transcription of the reference winner scan semantics
     (``knn-serial.c:121-124``): most conflates count and label."""

@@ -1,0 +1,680 @@
+"""The clustered (IVF) index — k-means partitioner, recall-targeted probed
+search, serve-cache and lint integration (``mpi_knn_tpu.ivf``).
+
+The gates:
+
+- recall@k ≥ the configured ``recall_target`` vs the f64 oracle on both a
+  synthetic clustered corpus and the REAL bundled digits corpus
+  (tie-aware: a backend that breaks a top-k-boundary tie differently is
+  not a miss — ``tests/oracle.recall_against_oracle``);
+- ``nprobe == partitions`` is the exact full scan: recall 1.0 and
+  value-level distance parity vs the serial backend unconditionally, and
+  BIT-identity gated on the platform's batched-vs-plain dot bit-stability
+  probe (the ``test_ref_mpi_shim`` convention: CPU Eigen's summation
+  order follows the contraction shape, which is environmental, not an
+  indexing bug);
+- save/load ``.npz`` round-trip is bit-identical end to end;
+- k-means is bit-deterministic per seed and the empty-cluster re-seed
+  path actually fires and repairs;
+- serving a clustered index through the bucket cache issues ZERO
+  steady-state compiles (counted at the XLA compiler via
+  ``jax.monitoring``, the test_serve.py machinery) and is bit-identical
+  to the one-shot search;
+- the ACCEPTANCE bound: on the SIFT-shaped 32k corpus at the default
+  ``recall_target=0.95``, the auto-tuned nprobe reaches measured
+  recall@10 ≥ 0.95 while the probed bytes per query — asserted from lint
+  R2's STRICT probed-bytes budget over the lowered serve program, not a
+  Python-side counter — stay under 25 % of the resident corpus;
+- lint rule R6 catches its injected counterexamples and the default ivf
+  lint cells are clean.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu import KNNConfig, query_knn
+from mpi_knn_tpu.ivf import (
+    build_ivf_index,
+    kmeans,
+    load_ivf_index,
+    save_ivf_index,
+    search_ivf,
+)
+from tests.oracle import oracle_all_knn, recall_against_oracle
+
+K = 10
+
+
+def _clustered(rng, m=2048, d=48, centers=24, spread=0.25):
+    """A corpus with genuine cluster structure — the workload IVF exists
+    for (uniform random data is clusterless and any partitioner fails its
+    preconditions there)."""
+    cents = rng.standard_normal((centers, d)).astype(np.float32) * 4
+    assign = rng.integers(0, centers, size=m)
+    return (
+        cents[assign] + rng.standard_normal((m, d)).astype(np.float32)
+        * spread * 4
+    ).astype(np.float32)
+
+
+@pytest.fixture
+def compile_counter():
+    """XLA backend-compile counter via jax.monitoring (the test_serve.py
+    machine check that a cache hit really compiled nothing)."""
+    from jax import monitoring
+
+    counts = []
+
+    def listener(name, secs, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            counts.append(name)
+
+    monitoring.register_event_duration_secs_listener(listener)
+    try:
+        yield counts
+    finally:
+        monitoring.clear_event_listeners()
+
+
+# ---------------------------------------------------------------------------
+# recall gates vs the f64 oracle
+
+
+def test_recall_gate_synthetic(rng):
+    X = _clustered(rng)
+    idx = build_ivf_index(X, KNNConfig(k=K, partitions=32))
+    sample = np.arange(0, 2048, 8)
+    d, i = search_ivf(idx, X[sample], query_ids=sample.astype(np.int32))
+    # wider oracle so the tie cohort at the k-th boundary is visible
+    want_d, want_i = oracle_all_knn(X, k=K + 5, queries=X[sample],
+                                    exclude_self=False)
+    for r, s in enumerate(sample):
+        want_d[r][want_i[r] == s] = np.inf  # self-exclusion by identity
+    order = np.argsort(want_d, axis=1, kind="stable")
+    want_d = np.take_along_axis(want_d, order, axis=1)
+    want_i = np.take_along_axis(want_i, order, axis=1)
+    rec = recall_against_oracle(i, want_d, want_i, K)
+    assert rec >= idx.cfg.recall_target, rec
+    # the auto-tune must have bought the recall sublinearly on clustered
+    # data, not by degenerating to the full scan
+    assert idx.nprobe < idx.partitions
+
+
+def test_recall_gate_digits(rng):
+    from mpi_knn_tpu.data.digits import load_digits
+
+    X, _ = load_digits()
+    X = X.astype(np.float32)
+    idx = build_ivf_index(X, KNNConfig(k=K, partitions=16))
+    sample = np.arange(0, len(X), 7)
+    d, i = search_ivf(idx, X[sample], query_ids=sample.astype(np.int32))
+    want_d, want_i = oracle_all_knn(X, k=K + 5, queries=X[sample],
+                                    exclude_self=False)
+    for r, s in enumerate(sample):
+        want_d[r][want_i[r] == s] = np.inf
+    order = np.argsort(want_d, axis=1, kind="stable")
+    want_d = np.take_along_axis(want_d, order, axis=1)
+    want_i = np.take_along_axis(want_i, order, axis=1)
+    assert recall_against_oracle(i, want_d, want_i, K) >= \
+        idx.cfg.recall_target
+
+
+def test_mixed_policy_composes(rng):
+    """precision_policy='mixed' rides the same probed candidates through
+    the compress-and-rerank recipe — the gate must hold there too."""
+    X = _clustered(rng, m=1024, d=64)
+    idx = build_ivf_index(
+        X, KNNConfig(k=K, partitions=8, nprobe=4,
+                     precision_policy="mixed")
+    )
+    sample = np.arange(0, 1024, 8)
+    _, i_mixed = search_ivf(idx, X[sample],
+                            query_ids=sample.astype(np.int32))
+    _, i_exact = search_ivf(idx, X[sample],
+                            query_ids=sample.astype(np.int32),
+                            precision_policy="exact")
+    # same probed candidates, exact rerank both ways: near-total agreement
+    agree = np.mean([
+        len(set(a.tolist()) & set(b.tolist())) / K
+        for a, b in zip(i_mixed, i_exact)
+    ])
+    assert agree >= 0.999, agree
+
+
+# ---------------------------------------------------------------------------
+# nprobe == partitions: the degenerate exact full scan
+
+
+def _batched_dot_bit_stable() -> bool:
+    """Environment probe for the bit-identity claim: does this backend's
+    f32 HIGHEST dot produce identical bits through the plain (q,d)×(c,d)
+    matmul and the batched (q,d)×(q,v,d) candidate form? True on the TPU
+    MXU; false where CPU Eigen picks different summation orders per
+    contraction shape (environmental — the ``test_ref_mpi_shim``
+    precedent)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.random((8, 48)) * 255, dtype=jnp.float32)
+    c = jnp.asarray(rng.random((128, 48)) * 255, dtype=jnp.float32)
+
+    plain = np.asarray(jax.jit(
+        lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)
+    )(q, c))
+    batched = np.asarray(jax.jit(
+        lambda a, b: jax.lax.dot_general(
+            a, jnp.broadcast_to(b, (8, 128, 48)),
+            (((1,), (2,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST)
+    )(q, c))
+    return bool(np.array_equal(plain, batched))
+
+
+def test_nprobe_equals_partitions_is_brute_force(rng):
+    from mpi_knn_tpu import all_knn
+
+    X = _clustered(rng, m=1024, d=32)
+    idx = build_ivf_index(X, KNNConfig(k=K, partitions=8, nprobe=8))
+    sample = np.arange(0, 1024, 4)
+    gd, gi = search_ivf(idx, X[sample], query_ids=sample.astype(np.int32))
+    want = all_knn(X, queries=X[sample], query_ids=sample,
+                   config=KNNConfig(k=K, backend="serial"))
+    wd, wi = np.asarray(want.dists), np.asarray(want.ids)
+    # value-level parity and full recall hold on ANY platform
+    np.testing.assert_allclose(gd, wd, rtol=1e-5, atol=1e-5)
+    rec = np.mean([
+        len(set(a.tolist()) & set(b.tolist())) / K for a, b in zip(gi, wi)
+    ])
+    assert rec == 1.0 or rec >= 0.999, rec
+    if not _batched_dot_bit_stable():
+        pytest.skip(
+            "environmental: this backend's f32 dot is not bit-stable "
+            "between the plain and batched contraction forms (probe), so "
+            "serial-vs-ivf bit-identity cannot hold here; value/recall "
+            "parity asserted above"
+        )
+    np.testing.assert_array_equal(gd, wd)
+
+    def tie_canonical(dists_arr, ids_arr):
+        out = np.empty_like(ids_arr)
+        for r in range(ids_arr.shape[0]):
+            out[r] = ids_arr[r][np.lexsort((ids_arr[r], dists_arr[r]))]
+        return out
+
+    np.testing.assert_array_equal(
+        tie_canonical(wd, wi), tie_canonical(gd, gi)
+    )
+
+
+# ---------------------------------------------------------------------------
+# save/load, determinism, empty-cluster re-seed
+
+
+def test_save_load_round_trip_bit_identity(rng, tmp_path):
+    X = _clustered(rng, m=512, d=24)
+    idx = build_ivf_index(X, KNNConfig(k=5, partitions=8))
+    Q = X[::16]
+    d1, i1 = search_ivf(idx, Q)
+    path = save_ivf_index(idx, str(tmp_path / "idx"))
+    idx2 = load_ivf_index(path)
+    assert idx2.cfg == idx.cfg
+    assert idx2.nprobe == idx.nprobe
+    np.testing.assert_array_equal(
+        np.asarray(idx.buckets), np.asarray(idx2.buckets)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(idx.centroids), np.asarray(idx2.centroids)
+    )
+    d2, i2 = search_ivf(idx2, Q)
+    np.testing.assert_array_equal(d1, d2)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_save_load_bf16_at_rest(rng, tmp_path):
+    X = _clustered(rng, m=512, d=24)
+    idx = build_ivf_index(
+        X, KNNConfig(k=5, partitions=8, dtype="bfloat16")
+    )
+    assert idx.nbytes_resident == idx.buckets.size * 2  # half-width store
+    d1, i1 = search_ivf(idx, X[::16])
+    path = save_ivf_index(idx, str(tmp_path / "idx16"))
+    idx2 = load_ivf_index(path)
+    assert str(idx2.buckets.dtype) == "bfloat16"
+    d2, i2 = search_ivf(idx2, X[::16])
+    np.testing.assert_array_equal(d1, d2)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_seeded_kmeans_determinism(rng):
+    X = _clustered(rng, m=600, d=16)
+    a = kmeans(X, 12, seed=3)
+    b = kmeans(X, 12, seed=3)
+    np.testing.assert_array_equal(
+        np.asarray(a.centroids), np.asarray(b.centroids)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.assignments), np.asarray(b.assignments)
+    )
+    c = kmeans(X, 12, seed=4)
+    assert not np.array_equal(np.asarray(a.centroids),
+                              np.asarray(c.centroids))
+    # and the whole trained INDEX is seed-deterministic
+    i1 = build_ivf_index(X, KNNConfig(k=5, partitions=12, ivf_seed=3))
+    i2 = build_ivf_index(X, KNNConfig(k=5, partitions=12, ivf_seed=3))
+    np.testing.assert_array_equal(
+        np.asarray(i1.bucket_ids), np.asarray(i2.bucket_ids)
+    )
+
+
+def test_empty_cluster_reseed_path(rng):
+    """More partitions than DISTINCT points: vanilla Lloyd's would leave
+    empty clusters and NaN centroids; the deterministic farthest-point
+    re-seed must keep every centroid finite and the index must still
+    answer exactly."""
+    base = rng.standard_normal((4, 8)).astype(np.float32) * 3
+    X = np.repeat(base, 8, axis=0)  # 32 rows, only 4 distinct
+    res = kmeans(X, 8, seed=0, init="random")
+    assert np.isfinite(np.asarray(res.centroids)).all()
+    # k-means on 4-distinct-point data: at most 4 clusters can own points,
+    # so the re-seed path has genuinely fired (some counts are 0, never NaN)
+    assert int((np.asarray(res.counts) == 0).sum()) >= 4
+    # ... and the full index still answers: nearest neighbor of each row
+    # is one of its 7 duplicates, excluded by the zero rule -> distances
+    # to the OTHER clusters' points are exact
+    idx = build_ivf_index(
+        X, KNNConfig(k=3, partitions=8, nprobe=8, ivf_seed=0,
+                     kmeans_init="random")
+    )
+    qids = np.arange(32, dtype=np.int32)
+    d, i = search_ivf(idx, X, query_ids=qids)
+    assert np.isfinite(d).all()
+    # duplicates are zero-distance-excluded; survivors are real neighbors
+    assert (i >= 0).all()
+    for r in range(32):
+        assert r not in i[r]
+
+
+# ---------------------------------------------------------------------------
+# serve-cache integration
+
+
+def test_serve_cache_zero_steady_state_compiles(rng, compile_counter):
+    X = _clustered(rng, m=1024, d=24)
+    idx = build_ivf_index(
+        X, KNNConfig(k=7, partitions=8, nprobe=2, query_bucket=64)
+    )
+    rng2 = np.random.default_rng(5)
+    warm_sizes = (64, 128)
+    for n in warm_sizes:
+        query_knn(rng2.standard_normal((n, 24)).astype(np.float32), idx)
+    compile_counter.clear()
+    for n in (1, 17, 63, 64, 65, 100, 128):
+        res = query_knn(
+            rng2.standard_normal((n, 24)).astype(np.float32), idx
+        )
+        assert res.ids.shape == (n, 7)
+    assert compile_counter == [], (
+        f"steady-state ivf serving compiled {len(compile_counter)} "
+        "program(s)"
+    )
+    assert len(idx._cache) == len(warm_sizes)
+
+
+def test_serve_matches_one_shot_bit_identically(rng):
+    from mpi_knn_tpu.serve import ServeSession
+
+    X = _clustered(rng, m=768, d=24)
+    idx = build_ivf_index(
+        X, KNNConfig(k=6, partitions=8, query_bucket=32)
+    )
+    Q = rng.standard_normal((70, 24)).astype(np.float32)
+    d1, i1 = search_ivf(idx, Q)
+    res = query_knn(Q, idx)
+    np.testing.assert_array_equal(res.dists, d1)
+    np.testing.assert_array_equal(res.ids, i1)
+    sess = ServeSession(idx)
+    outs = list(sess.stream([Q[:20], Q[20:50], Q[50:]]))
+    np.testing.assert_array_equal(
+        np.concatenate([o.ids for o in outs]), i1
+    )
+
+
+def test_serve_refuses_corpus_side_changes(rng):
+    X = _clustered(rng, m=256, d=16)
+    idx = build_ivf_index(X, KNNConfig(k=5, partitions=4))
+    with pytest.raises(ValueError, match="corpus-side"):
+        idx.compatible_cfg(idx.cfg.replace(partitions=8))
+    with pytest.raises(ValueError, match="corpus-side"):
+        idx.compatible_cfg(idx.cfg.replace(ivf_seed=9))
+    # nprobe is query-side: varying it is allowed and resolves
+    assert idx.compatible_cfg(idx.cfg.replace(nprobe=2)).nprobe == 2
+    assert idx.compatible_cfg(idx.cfg.replace(nprobe=None)).nprobe == \
+        idx.nprobe
+    # knobs the probed path cannot honor are refused, not silently
+    # ignored — a measurement labeled 'approx' for a run that executed
+    # the exact rerank would be a lie
+    with pytest.raises(ValueError, match="topk_method"):
+        idx.compatible_cfg(idx.cfg.replace(topk_method="approx"))
+    with pytest.raises(ValueError, match="matmul_precision"):
+        idx.compatible_cfg(idx.cfg.replace(matmul_precision="high"))
+    with pytest.raises(ValueError, match="merge_schedule"):
+        idx.compatible_cfg(idx.cfg.replace(merge_schedule="stream"))
+    with pytest.raises(ValueError, match="topk_method"):
+        build_ivf_index(X, KNNConfig(k=5, partitions=4,
+                                     topk_method="approx"))
+
+
+def test_build_refusals():
+    X = np.zeros((64, 8), np.float32)
+    with pytest.raises(ValueError, match="partitions"):
+        build_ivf_index(X, KNNConfig(k=3))
+    with pytest.raises(ValueError, match="backend"):
+        build_ivf_index(X, KNNConfig(k=3, partitions=4, backend="pallas"))
+    with pytest.raises(ValueError, match="metric"):
+        KNNConfig(k=3, partitions=4, metric="cosine")
+    with pytest.raises(ValueError, match="nprobe"):
+        KNNConfig(k=3, partitions=4, nprobe=8)
+    with pytest.raises(ValueError, match="nprobe"):
+        KNNConfig(k=3, nprobe=2)
+    with pytest.raises(ValueError, match="dtype"):
+        build_ivf_index(X, KNNConfig(k=3, partitions=4, dtype="float64"))
+    with pytest.raises(ValueError, match="exceeds"):
+        build_ivf_index(np.zeros((4, 8), np.float32),
+                        KNNConfig(k=3, partitions=8))
+
+
+def test_cli_refusals_exit_2(tmp_path, rng):
+    from mpi_knn_tpu.ivf import cli as ivf_cli
+    from mpi_knn_tpu.serve import cli as serve_cli
+
+    assert ivf_cli.main(
+        ["--data", "synthetic:64x8c2", "--partitions", "4",
+         "--metric", "cosine", "--out", str(tmp_path / "x.npz")]
+    ) == 2
+    assert ivf_cli.main(
+        ["--data", "synthetic:64x8c2", "--partitions", "4",
+         "--backend", "pallas", "--out", str(tmp_path / "x.npz")]
+    ) == 2
+    assert ivf_cli.main(
+        ["--data", "synthetic:64x8c2", "--partitions", "4",
+         "--nprobe", "9", "--out", str(tmp_path / "x.npz")]
+    ) == 2
+    # a real index, then unhonorable query flags against it
+    path = str(tmp_path / "ok.npz")
+    assert ivf_cli.main(
+        ["--data", "synthetic:256x16c4", "--partitions", "4", "--k", "3",
+         "--out", path, "-q"]
+    ) == 0
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--backend", "pallas", "--synthetic", "8"]
+    ) == 2
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--metric", "cosine", "--synthetic", "8"]
+    ) == 2
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--nprobe", "99", "--synthetic", "8"]
+    ) == 2
+    # corpus-side flags baked into the saved layout: explicitly passing
+    # them alongside --index-load is refused, never silently dropped
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--corpus-tile", "4096", "--synthetic", "8"]
+    ) == 2
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--ring-schedule", "bidir", "--synthetic", "8"]
+    ) == 2
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--dtype", "bfloat16", "--synthetic", "8"]
+    ) == 2
+    # --nprobe without a clustered index is a silently-ignored knob: refuse
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--nprobe", "2",
+         "--synthetic", "8"]
+    ) == 2
+    # the honorable combination serves
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--synthetic", "16", "--batch", "8", "--bucket", "8", "-q"]
+    ) == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bound: lint-asserted probed bytes on the 32k SIFT corpus
+
+
+def test_sift32k_recall_target_with_sublinear_probed_bytes():
+    """ISSUE 5 acceptance: at the default recall_target=0.95 the
+    auto-tuned nprobe reaches measured recall@10 ≥ 0.95 on the
+    SIFT-shaped 32k corpus while scanning < 25 % of corpus bytes per
+    query — and the probed-bytes bound is asserted from lint R2's STRICT
+    budget over the LOWERED serve program (plus R6's gather discipline),
+    not from Python-side counters."""
+    from mpi_knn_tpu.analysis import engine
+    from mpi_knn_tpu.analysis.lowering import (
+        LintTarget,
+        _ivf_meta,
+        hlo_texts,
+    )
+    from mpi_knn_tpu.data.synthetic import make_sift_like
+    from mpi_knn_tpu.serve.engine import SCRATCH_PARAMS, lower_bucket
+
+    X = make_sift_like(m=32768, d=128, seed=0)
+    cfg = KNNConfig(k=K, partitions=64, kmeans_iters=10, query_bucket=256)
+    assert cfg.recall_target == 0.95  # the DEFAULT target is the subject
+    idx = build_ivf_index(X, cfg)
+
+    # measured recall@10 vs the f64 oracle on a held-out sample
+    sample = np.linspace(0, 32767, num=128, dtype=np.int64)
+    _, got = search_ivf(idx, X[sample], query_ids=sample.astype(np.int32))
+    X64 = X.astype(np.float64)
+    od = (
+        (X64[sample] ** 2).sum(1)[:, None]
+        + (X64**2).sum(1)[None, :]
+        - 2.0 * (X64[sample] @ X64.T)
+    )
+    od[od <= 1e-9] = np.inf
+    od[np.arange(len(sample)), sample] = np.inf
+    order = np.argsort(od, axis=1, kind="stable")[:, : K + 5]
+    want_d = np.take_along_axis(od, order, axis=1)
+    rec = recall_against_oracle(got, want_d, order.astype(np.int32), K)
+    assert rec >= 0.95, f"auto-tuned nprobe={idx.nprobe}: recall {rec}"
+
+    # the probed-bytes bound, from the compiled program: lower the REAL
+    # serve-cache cell for this index and run R2 in strict mode with the
+    # probe gather as the declared budget — if anything in the program
+    # materialized more than nprobe·bucket_cap·d per query row (e.g. a
+    # full-corpus scan), R2 flags it and this assert fails
+    serve_cfg = idx.compatible_cfg(idx.cfg)
+    lowered, q_pad, q_tile = lower_bucket(idx, serve_cfg, 256)
+    meta = {
+        **_ivf_meta(idx, serve_cfg, q_tile),
+        "serve": True,
+        "donated_params": SCRATCH_PARAMS,
+        "resident_bytes": idx.nbytes_resident,
+    }
+    probe_budget_bytes = meta["budget_elems"] * meta["acc_bytes"]
+    corpus_bytes_per_batch = q_tile * idx.m * idx.dim * 4
+    assert probe_budget_bytes < 0.25 * corpus_bytes_per_batch, (
+        "the lint budget itself must be sublinear: "
+        f"{probe_budget_bytes} vs corpus-scan {corpus_bytes_per_batch}"
+    )
+    target = LintTarget("ivf", "l2", "float32", serve=True)
+    ctx = engine.LintContext(target=target, cfg=serve_cfg, meta=meta)
+    findings, ran = engine.run_rules(hlo_texts(lowered), ctx)
+    assert "R2-memory" in ran and "R6-ivf-probe" in ran
+    assert not findings, [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# lint: R6 counterexamples + the default ivf cells
+
+
+def _r6_ctx():
+    from mpi_knn_tpu.analysis import engine
+    from mpi_knn_tpu.analysis.lowering import LintTarget
+
+    return engine.LintContext(
+        target=LintTarget("ivf", "l2", "float32"),
+        cfg=KNNConfig(k=4, partitions=8, nprobe=2),
+        meta={"q_tile": 8, "c_tile": 64, "acc_bytes": 4,
+              "partitions": 8, "dim": 16},
+    )
+
+
+def _run_r6(body):
+    from mpi_knn_tpu.analysis import engine
+    from mpi_knn_tpu.analysis import rules as rules_mod
+
+    r6 = [r for r in rules_mod.RULES if r.name == "R6-ivf-probe"]
+    mod = f"""\
+HloModule m, entry_computation_layout={{(f32[8,16]{{1,0}},s32[8,2]{{1,0}},\
+f32[512,16]{{1,0}})->f32[8,4]{{1,0}}}}
+
+ENTRY %main.1 (a.1: f32[8,16], p.1: s32[8,2], c.1: f32[512,16]) -> f32[8,4] {{
+  %a.1 = f32[8,16]{{1,0}} parameter(0)
+  %p.1 = s32[8,2]{{1,0}} parameter(1)
+  %c.1 = f32[512,16]{{1,0}} parameter(2)
+{body}
+}}
+"""
+    findings, _ = engine.run_rules({"before_opt": mod}, _r6_ctx(), r6)
+    return findings
+
+
+def test_r6_catches_injected_counterexamples():
+    gather = (
+        "  %g.1 = f32[8,64,16]{2,1,0} gather(%c.1, %p.1), "
+        "offset_dims={2}, collapsed_slice_dims={0}, start_index_map={0}, "
+        "index_vector_dim=2, slice_sizes={1,16}\n"
+    )
+    # broadcast stands in for a candidate tensor NOT derived from a gather
+    bcast = (
+        "  %b.1 = f32[8,512,16]{2,1,0} broadcast(%c.1), dimensions={1,2}\n"
+    )
+    probed_dot = (
+        "  %d1.1 = f32[8,4]{1,0} dot(%a.1, %g.1), lhs_batch_dims={0}, "
+        "lhs_contracting_dims={1}, rhs_batch_dims={0}, "
+        "rhs_contracting_dims={2}, operand_precision={highest,highest}\n"
+    )
+    unprobed_dot = (
+        "  %d2.1 = f32[8,4]{1,0} dot(%a.1, %b.1), lhs_batch_dims={0}, "
+        "lhs_contracting_dims={1}, rhs_batch_dims={0}, "
+        "rhs_contracting_dims={2}, operand_precision={highest,highest}\n"
+    )
+    corpus_dot = (
+        "  %d3.1 = f32[8,512]{1,0} dot(%a.1, %c.1), "
+        "lhs_contracting_dims={1}, rhs_contracting_dims={1}, "
+        "operand_precision={highest,highest}\n"
+    )
+    root = "  ROOT %r.1 = f32[8,4]{1,0} add(%d1.1, %d1.1)"
+
+    # the declared shape: gather feeding the batched exact dot — clean
+    assert not _run_r6(gather + probed_dot + root)
+    # a batched dot NOT fed by a gather: scores unprobed rows
+    bad = _run_r6(gather + bcast + probed_dot + unprobed_dot + root)
+    assert any("no gather" in f.message.lower() for f in bad)
+    # an un-batched full-corpus dot bypasses partition pruning entirely
+    bad = _run_r6(gather + probed_dot + corpus_dot + root)
+    assert any("bypasses the partition pruning" in f.message for f in bad)
+    # no batched candidate dot at all: the contract is vacuous
+    bad = _run_r6(gather + corpus_dot.replace("%d3", "%d1") + root)
+    assert any("vacuous" in f.message.lower() for f in bad)
+
+
+def test_r2_strict_budget_catches_full_corpus_materialization():
+    """R2 in strict (budget_elems) mode: a corpus-sized GATHER result is a
+    finding even though the corpus itself is an exempt parameter — the
+    probed-bytes bound is the claim, not 'no bigger than the input'."""
+    from mpi_knn_tpu.analysis import engine
+    from mpi_knn_tpu.analysis import rules as rules_mod
+
+    r2 = [r for r in rules_mod.RULES if r.name == "R2-memory"]
+    ctx = _r6_ctx()
+    ctx.meta["budget_elems"] = 8 * 64 * 16  # q_tile * v * d
+    big = (
+        "  %g.1 = f32[8,512,16]{2,1,0} gather(%c.1, %p.1), "
+        "offset_dims={2}, collapsed_slice_dims={0}, start_index_map={0}, "
+        "index_vector_dim=2, slice_sizes={1,16}\n"
+        "  ROOT %r.1 = f32[8,4]{1,0} slice(%g.1), "
+        "slice={[0:8], [0:4], [0:1]}"
+    )
+    mod = f"""\
+HloModule m, entry_computation_layout={{(s32[8,2]{{1,0}},\
+f32[512,16]{{1,0}})->f32[8,4]{{1,0}}}}
+
+ENTRY %main.1 (p.1: s32[8,2], c.1: f32[512,16]) -> f32[8,4] {{
+  %p.1 = s32[8,2]{{1,0}} parameter(0)
+  %c.1 = f32[512,16]{{1,0}} parameter(1)
+{big}
+}}
+"""
+    findings, _ = engine.run_rules({"before_opt": mod}, ctx, r2)
+    assert any("probed-bytes" in f.message for f in findings), (
+        [f.message for f in findings]
+    )
+
+
+def test_default_ivf_lint_cells_are_clean():
+    """The positive lint criterion: every default ivf cell lowers and
+    passes all applicable rules — R6 and strict-R2 run on every one (zero
+    batched dots or an over-budget buffer would be findings, so 'ok' is
+    non-vacuous), R5 on the serve cells."""
+    from mpi_knn_tpu.analysis import engine, lowering
+
+    targets = [t for t in lowering.default_targets() if t.backend == "ivf"]
+    assert len(targets) == 4, targets
+    for t in targets:
+        res = engine.lint_target(t)
+        assert res.skipped is None, (t.label, res.skipped)
+        assert res.ok, (t.label, [f.message for f in res.findings])
+        assert "R6-ivf-probe" in res.rules_run
+        if t.serve:
+            assert "R5-donation" in res.rules_run
+
+
+def test_build_from_serve_corpus_index(rng):
+    """An IVFIndex built FROM a serial-layout serve.CorpusIndex (its
+    centered resident tiles, no second centering pass) answers
+    identically to one built from the raw array."""
+    from mpi_knn_tpu.serve import build_index
+
+    X = _clustered(rng, m=512, d=24)
+    cfg = KNNConfig(k=5, partitions=8, nprobe=3)
+    from_array = build_ivf_index(X, cfg)
+    corpus_idx = build_index(X, KNNConfig(k=5, backend="serial"))
+    from_index = build_ivf_index(corpus_idx, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(from_array.bucket_ids),
+        np.asarray(from_index.bucket_ids),
+    )
+    Q = X[::16]
+    d1, i1 = search_ivf(from_array, Q)
+    d2, i2 = search_ivf(from_index, Q)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-6, atol=1e-6)
+    # non-serial layouts cannot donate their corpus back
+    ring_like = build_index(X, KNNConfig(k=5, backend="pallas"))
+    with pytest.raises(ValueError, match="serial-layout"):
+        build_ivf_index(ring_like, cfg)
+
+
+def test_config_round_trips_through_npz(rng, tmp_path):
+    """Every KNNConfig field survives the save/load JSON (a new field
+    added without npz support would silently reload as its default)."""
+    X = _clustered(rng, m=256, d=16)
+    cfg = KNNConfig(k=5, partitions=4, nprobe=2, kmeans_iters=7,
+                    kmeans_init="random", ivf_seed=11)
+    idx = build_ivf_index(X, cfg)
+    path = save_ivf_index(idx, str(tmp_path / "cfg"))
+    idx2 = load_ivf_index(path)
+    assert dataclasses.asdict(idx2.cfg) == dataclasses.asdict(idx.cfg)

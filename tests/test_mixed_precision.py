@@ -19,19 +19,12 @@ import pytest
 
 from mpi_knn_tpu import KNNConfig, all_knn
 from mpi_knn_tpu.ops.rerank import mixed_applies, overfetch_width
-from tests.oracle import oracle_all_knn
+from tests.oracle import oracle_all_knn, recall_against_oracle
 
 K = 10
 RECALL_GATE = 0.999
 
 BACKENDS = ["serial", "ring", "pallas"]
-
-
-def _recall(got_ids, want_ids, k):
-    got = np.asarray(got_ids)
-    return np.mean(
-        [len(set(got[r]) & set(want_ids[r])) / k for r in range(len(got))]
-    )
 
 
 def _mnist_like(rng, m=512, d=96):
@@ -55,7 +48,7 @@ def test_mixed_recall_gate_vs_f64_oracle(rng, backend):
         corpus_tile=128,
     )
     want_d, want_i = oracle_all_knn(X, k=K)
-    rec = _recall(got.ids, want_i, K)
+    rec = recall_against_oracle(got.ids, want_d, want_i, K)
     assert rec >= RECALL_GATE, f"{backend}: recall@10 {rec} < {RECALL_GATE}"
 
 
@@ -73,7 +66,7 @@ def test_mixed_matches_oracle_both_metrics(rng, backend, metric):
         corpus_tile=128,
     )
     want_d, want_i = oracle_all_knn(X, k=8, metric=metric)
-    assert _recall(got.ids, want_i, 8) >= RECALL_GATE
+    assert recall_against_oracle(got.ids, want_d, want_i, 8) >= RECALL_GATE
     np.testing.assert_allclose(
         np.asarray(got.dists), want_d, rtol=1e-3, atol=1e-3
     )
@@ -120,7 +113,7 @@ def test_overfetch_wider_than_tile_degenerates_to_exact(rng, backend):
     exact = all_knn(X, precision_policy="exact", **kw)
     mixed = all_knn(X, precision_policy="mixed", **kw)
     want_d, want_i = oracle_all_knn(X, k=10)
-    assert _recall(mixed.ids, want_i, 10) >= RECALL_GATE
+    assert recall_against_oracle(mixed.ids, want_d, want_i, 10) >= RECALL_GATE
     np.testing.assert_allclose(
         np.asarray(mixed.dists), np.asarray(exact.dists), rtol=1e-5,
         atol=1e-5,
@@ -180,7 +173,7 @@ def test_mixed_both_merge_schedules(rng, schedule):
     a = all_knn(X, k=K, backend="serial", precision_policy="mixed",
                 merge_schedule=schedule, query_tile=64, corpus_tile=128)
     want_d, want_i = oracle_all_knn(X, k=K)
-    assert _recall(a.ids, want_i, K) >= RECALL_GATE
+    assert recall_against_oracle(a.ids, want_d, want_i, K) >= RECALL_GATE
 
 
 @pytest.mark.parametrize("variant", ["tiles", "sweep"])
@@ -191,7 +184,7 @@ def test_mixed_pallas_variants(rng, variant):
     got = all_knn(X, k=K, backend="pallas", pallas_variant=variant,
                   precision_policy="mixed", query_tile=64, corpus_tile=128)
     want_d, want_i = oracle_all_knn(X, k=K)
-    assert _recall(got.ids, want_i, K) >= RECALL_GATE
+    assert recall_against_oracle(got.ids, want_d, want_i, K) >= RECALL_GATE
 
 
 def test_mixed_ring_resumable_checkpoint_layout_unchanged(rng, tmp_path):
