@@ -1,8 +1,33 @@
 """Headline benchmark: MNIST-60k×784 all-kNN, k=10 (BASELINE.md north star:
 < 1 s on a v5e-8 at recall@10 parity with the serial reference semantics).
 
-Prints ONE JSON line:
+Prints ONE JSON line per series:
     {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Architecture (ISSUE 6): ``python bench.py`` is a SUPERVISOR. Every series
+of a round runs in its own child subprocess under the resilience worker
+runner (``mpi_knn_tpu.resilience``): the child writes monotonic heartbeat
+progress, and the supervisor kills on *beat starvation* (a wedged
+transport stops beating immediately) with wall-clock as the outer bound
+only. One wedged series can therefore never take down its siblings —
+the failure modes that erased 4 of 5 r5 rounds (whole-process watchdog,
+``rc: 2``, zero banked signal) are structurally gone:
+
+- a completed series banks its real measurement line, always;
+- a wedged/crashed series banks a structured ``"failed": true`` line
+  under its own series name (value = how long it ran before the kill);
+- the process exits 0 whenever at least one series banked;
+- only when NO series banked anything does the round fall to the last
+  rung of the ladder: a serial/CPU re-run in a fresh subprocess at
+  ``BENCH_FALLBACK_M``, banked with the ``"degraded": "cpu-fallback"``
+  marker (PR 4's convention) — a degraded number beats an empty round.
+
+Series come from ``BENCH_SERIES``: a JSON list of env-overlay objects,
+each overlaid on this process's environment for one child (optional
+``"name"`` key labels supervisor notes). Unset = one series from the
+ambient knobs, which is the PR-driver contract (exactly one stdout line).
+``BENCH_DOCTOR=1`` runs the ``mpi-knn doctor`` preflight probe first and
+skips straight to the failure ladder if the device is already wedged.
 
 Methodology (mirrors the reference, which times ONLY the distance/top-k
 phase — ``/root/reference/knn-serial.c:70,94-98`` — not I/O or voting):
@@ -31,10 +56,14 @@ BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_IVF_PARTITIONS /
 BENCH_IVF_NPROBE (clustered-index path: k-means partitions trained
 outside the timed region, per-query probed scan timed; the series name
 carries the knobs and the gate is the configured recall_target — the
-clustered rung's own acceptance bar), BENCH_WATCHDOG_S (0 disables),
+clustered rung's own acceptance bar), BENCH_WATCHDOG_S (per-series wall
+bound, 0 disables), BENCH_BEAT_TIMEOUT_S (per-series beat-starvation
+bound, 0 disables), BENCH_SERIES / BENCH_DOCTOR (supervisor, above),
 BENCH_PLATFORM (forces jax_platforms via the config API — JAX_PLATFORMS
 alone is ignored by the axon TPU plugin), TKNN_MNIST (real data path;
-synthetic surrogate otherwise).
+synthetic surrogate otherwise), TKNN_FAULTS (fault injection — see
+mpi_knn_tpu/resilience/faults.py; the bench series fault site is
+``bench-series``).
 
 The recall gate is FIXED at 0.999 regardless of knobs — it is the north
 star's acceptance bar, not a tunable. Setting BENCH_RT below it tunes
@@ -45,7 +74,6 @@ design (speed bought with recall does not count).
 import json
 import os
 import sys
-import threading
 import time
 
 import numpy as np
@@ -56,18 +84,21 @@ NORTH_STAR_CHIPS = 8
 RECALL_GATE = 0.999
 
 
-def metric_name() -> str:
+def metric_name(env=None) -> str:
     """One construction of the series name, shared by the success and
-    watchdog paths so a failure always lands in the real series. The IVF
-    knobs are part of the name: a clustered run measures a different
-    computation (sublinear probed scan at a measured recall target) and
-    must never masquerade as the exact full-scan series."""
-    m = int(os.environ.get("BENCH_M", "60000"))
-    k = int(os.environ.get("BENCH_K", "10"))
+    failure paths so a failure always lands in the real series — and
+    computable by the supervisor from a child's env when the child died
+    before printing anything. The IVF knobs are part of the name: a
+    clustered run measures a different computation (sublinear probed scan
+    at a measured recall target) and must never masquerade as the exact
+    full-scan series."""
+    env = os.environ if env is None else env
+    m = int(env.get("BENCH_M", "60000"))
+    k = int(env.get("BENCH_K", "10"))
     ivf = ""
-    if os.environ.get("BENCH_IVF_PARTITIONS"):
-        p = os.environ["BENCH_IVF_PARTITIONS"]
-        n = os.environ.get("BENCH_IVF_NPROBE", "auto")
+    if env.get("BENCH_IVF_PARTITIONS"):
+        p = env["BENCH_IVF_PARTITIONS"]
+        n = env.get("BENCH_IVF_NPROBE", "auto")
         ivf = f"_ivf{p}p{n}"
     return f"mnist{m // 1000}k_allknn_k{k}{ivf}_seconds"
 
@@ -89,15 +120,28 @@ def oracle_topk(X: np.ndarray, sample: np.ndarray, k: int) -> np.ndarray:
 
 
 def main() -> int:
+    """ONE series measurement — always a supervised child process
+    (``TKNN_BENCH_CHILD=1``). Heartbeats bracket every step that can
+    hang, so the supervisor's beat-starvation kill names the wedged
+    step; the injectable ``bench-series`` fault site stands in for a
+    wedged transport in tier-1."""
+    from mpi_knn_tpu.resilience.faults import fault_point
+    from mpi_knn_tpu.resilience.heartbeat import maybe_beat
+
+    maybe_beat("start")
+    fault_point("bench-series")
     if os.environ.get("BENCH_PLATFORM"):
         # the axon TPU plugin ignores JAX_PLATFORMS; the shared helper is
         # the only reliable way to keep a CPU smoke run off the tunnel
         from mpi_knn_tpu.utils.platform import force_platform
 
         force_platform(os.environ["BENCH_PLATFORM"])
+    maybe_beat("platform")
 
     import jax
     import jax.numpy as jnp
+
+    maybe_beat("jax-import")
 
     m = int(os.environ.get("BENCH_M", "60000"))
     k = int(os.environ.get("BENCH_K", "10"))
@@ -218,6 +262,7 @@ def main() -> int:
     from mpi_knn_tpu.utils.timing import device_sync
 
     X, _, source = load_mnist(m=m)
+    maybe_beat("data")
     cfg = KNNConfig(
         k=k,
         backend=backend,
@@ -276,6 +321,7 @@ def main() -> int:
         # (the dense series' timer placement — a per-rep host centering
         # pass would make the two series incomparable)
         index = build_ivf_index(X, cfg)
+        maybe_beat("index-build")
         rcfg = index.compatible_cfg(index.cfg)
         qids = np.arange(m, dtype=np.int32)
         q_tiles, qid_tiles, q_pad, _ = prepare_query_tiles(
@@ -284,12 +330,14 @@ def main() -> int:
         device_sync(q_tiles)
         d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)  # warm
         device_sync(d, i)
+        maybe_beat("warm")
         times = []
-        for _ in range(reps):
+        for r in range(reps):
             t0 = time.perf_counter()
             d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)
             device_sync(d, i)
             times.append(time.perf_counter() - t0)
+            maybe_beat(f"rep{r}")
         got_ids = np.asarray(
             jax.device_get(i)
         ).reshape(q_pad, rcfg.k)[:m]
@@ -302,13 +350,15 @@ def main() -> int:
         # compile + warm up
         result = all_knn(Xd, config=cfg)
         device_sync(result.dists)
+        maybe_beat("warm")
 
         times = []
-        for _ in range(reps):
+        for r in range(reps):
             t0 = time.perf_counter()
             result = all_knn(Xd, config=cfg)
             device_sync(result.dists, result.ids)
             times.append(time.perf_counter() - t0)
+            maybe_beat(f"rep{r}")
     # median is the headline (VERDICT r1 #9): honest under transport noise;
     # min stays visible on stderr for best-case comparisons
     value = float(np.median(times))
@@ -320,6 +370,7 @@ def main() -> int:
     else:
         got = np.asarray(jax.device_get(result.ids[jnp.asarray(sample)]))
     recall = recall_at_k(got, want)
+    maybe_beat("oracle")
 
     n_chips = jax.local_device_count() if jax.default_backend() == "tpu" else 1
     target_here = NORTH_STAR_SECONDS * (NORTH_STAR_CHIPS / n_chips)
@@ -332,14 +383,7 @@ def main() -> int:
         "unit": "s",
         "vs_baseline": round(vs, 3),
     }
-    # set-and-print must be atomic against the watchdog's check-and-print
-    # (a fired watchdog spends minutes in the fallback subprocess; the
-    # primary finishing in that window must not produce a SECOND stdout
-    # measurement line). The watchdog os._exits while holding this lock,
-    # so losing the race here means never reaching the duplicate print.
-    with _EMIT_LOCK:
-        _COMPLETED.set()  # suppress the watchdog from here on
-        print(json.dumps(line), flush=True)
+    print(json.dumps(line), flush=True)
     # context for humans / the judge, on stderr so stdout stays one line
     print(
         json.dumps(
@@ -367,18 +411,91 @@ def main() -> int:
     return 0
 
 
-_COMPLETED = threading.Event()
-# serializes "check _COMPLETED, then print a measurement line" between the
-# main thread and the watchdog thread: stdout carries EXACTLY one
-# measurement line per run, whoever takes the lock first wins
-_EMIT_LOCK = threading.Lock()
+# ---------------------------------------------------------------------------
+# Supervisor: one child subprocess per series, heartbeat-watchdogged
 
 
-def _cpu_fallback_line():
-    """Re-run the bench on the CPU platform in a FRESH subprocess (the
-    wedged transport lives in this process; the fallback must not share
-    it) at a CPU-feasible corpus size. Returns the fallback's parsed JSON
-    measurement line, or None if it too failed.
+def _note(msg: str) -> None:
+    # never JSON-shaped: harness tooling reads the LAST '{'-prefixed
+    # stderr line as the measurement context object
+    print(f"bench-supervisor: {msg}", file=sys.stderr, flush=True)
+
+
+def _parse_series():
+    """BENCH_SERIES (JSON list of env-overlay objects) → list of dicts;
+    unset = one series from the ambient knobs. Malformed input is a loud
+    usage error (None return → supervisor exits 2): a typo'd round spec
+    silently measuring the default series would be a mislabeled round."""
+    raw = os.environ.get("BENCH_SERIES")
+    if not raw:
+        return [{}]
+    try:
+        doc = json.loads(raw)
+        if not isinstance(doc, list) or not doc or not all(
+            isinstance(s, dict) for s in doc
+        ):
+            raise ValueError("want a non-empty JSON list of objects")
+    except (json.JSONDecodeError, ValueError) as e:
+        print(
+            json.dumps({
+                "error": f"bad BENCH_SERIES: {e} — want a JSON list of "
+                'env-overlay objects, e.g. [{"name": "exact"}, '
+                '{"name": "mixed", "BENCH_PRECISION_POLICY": "mixed"}]'
+            }),
+            file=sys.stderr,
+        )
+        return None
+    return doc
+
+
+def _series_label(i: int, overlay: dict) -> str:
+    return str(overlay.get("name") or f"series{i}")
+
+
+def _child_env(overlay: dict) -> dict:
+    env = dict(os.environ)
+    # children never recurse into supervision, and never re-run preflight
+    for k in ("BENCH_SERIES", "BENCH_DOCTOR"):
+        env.pop(k, None)
+    for k, v in overlay.items():
+        if k == "name":
+            continue
+        env[k] = str(v)
+    env["TKNN_BENCH_CHILD"] = "1"
+    return env
+
+
+def _measurement_line(stdout: str):
+    """The LAST metric/value JSON line of a child's stdout, or None."""
+    found = None
+    for line in stdout.splitlines():
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and "metric" in doc and "value" in doc:
+            found = doc
+    return found
+
+
+def _is_usage_error(res) -> bool:
+    """A child that refused its knobs (loud exit-2 convention): a
+    configuration bug, not a device failure — it must NOT be banked as a
+    failed measurement (the series name would be lying) and must NOT
+    trigger the CPU fallback (the bad knobs would just recur)."""
+    return (
+        res.status == "crashed"
+        and res.returncode == 2
+        and '"error"' in (res.stderr_tail + res.stdout)
+    )
+
+
+def _cpu_fallback_line(primary_metric: str):
+    """The round ladder's LAST rung: re-run the bench on the CPU platform
+    in a fresh supervised subprocess (the wedged transport lives in the
+    dead children; the fallback must share nothing with them) at a
+    CPU-feasible corpus size. Returns the parsed measurement line, or
+    None if the fallback failed too.
 
     4 of 5 r5 rounds banked only ``rc: 2`` watchdog JSON ("no measurement
     completed") — a dead chip erased the whole round's signal. The CPU
@@ -387,134 +504,179 @@ def _cpu_fallback_line():
     bench_ops.py rationale), which beats banking nothing.
     """
     if os.environ.get("BENCH_NO_FALLBACK") == "1":
-        return None  # recursion guard: the fallback itself never falls back
-    import subprocess
+        return None  # recursion/choice guard: the last rung is opt-out-able
+    from mpi_knn_tpu.resilience.worker import run_supervised
 
     m = min(int(os.environ.get("BENCH_M", "60000")),
             int(os.environ.get("BENCH_FALLBACK_M", "8000")))
     env = dict(os.environ)
     # serial CPU is the one configuration with no device transport, no
-    # mesh and no knob conflicts; strip ring/pallas knobs the forced
+    # mesh and no knob conflicts; strip ring/pallas/ivf knobs the forced
     # backend would loudly refuse (their loud-exit-2 conflict checks are
-    # correct for user runs — the fallback must not trip them)
+    # correct for user runs — the fallback must not trip them), plus the
+    # fault-injection arming (the last rung must run clean: an injected
+    # hang propagating into the fallback would erase the round after all)
+    # and the supervisor's own knobs
     for k in ("BENCH_RING_SCHEDULE", "BENCH_RING_XFER",
               "BENCH_PALLAS_VARIANT", "BENCH_IVF_PARTITIONS",
-              "BENCH_IVF_NPROBE"):
+              "BENCH_IVF_NPROBE", "BENCH_SERIES", "BENCH_DOCTOR",
+              "TKNN_FAULTS"):
         env.pop(k, None)
     env.update(
         BENCH_PLATFORM="cpu",
         BENCH_BACKEND="serial",
         BENCH_M=str(m),
-        BENCH_WATCHDOG_S="0",  # the subprocess timeout below is the bound
         BENCH_NO_FALLBACK="1",
+        TKNN_BENCH_CHILD="1",
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True,
-            timeout=float(os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "420")),
-        )
-    except Exception:
+    res = run_supervised(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        beat_timeout_s=None,  # the wall bound below is the contract
+        wall_timeout_s=float(
+            os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "420")
+        ),
+    )
+    doc = _measurement_line(res.stdout) if res.ok else None
+    if doc is None:
         return None
-    for line in proc.stdout.splitlines():
-        try:
-            doc = json.loads(line)
-        except json.JSONDecodeError:
+    # the fallback reports under an explicitly-marked series name:
+    # a reduced m alone is NOT collision-proof (a genuine BENCH_M=8000
+    # TPU series would share "mnist8k_..."), so the marker is part of the
+    # name and the degraded number can never poison any primary series;
+    # vs_baseline stays 0 — a CPU number does not beat a TPU north star
+    # by definition
+    doc["metric"] = doc["metric"] + "_cpu_fallback"
+    doc["vs_baseline"] = 0.0
+    doc["degraded"] = "cpu-fallback"
+    doc["fallback_of"] = primary_metric
+    return doc
+
+
+def _series_timeouts(env: dict):
+    """Per-series watchdog bounds, read from the child's (overlaid) env:
+    a series overlay may tighten or loosen the ambient knobs — a
+    wedge-prone configuration gets a short leash while its healthy
+    siblings keep the full first-compile allowance. 0 disables."""
+    beat = float(env.get("BENCH_BEAT_TIMEOUT_S", "240"))
+    wall = float(env.get("BENCH_WATCHDOG_S", "480"))
+    return (beat if beat > 0 else None, wall if wall > 0 else None)
+
+
+def supervise() -> int:
+    from mpi_knn_tpu.resilience.worker import run_supervised
+
+    series = _parse_series()
+    if series is None:
+        return 2
+
+    preflight_ok = True
+    if os.environ.get("BENCH_DOCTOR") == "1":
+        from mpi_knn_tpu.resilience.doctor import run_probe
+
+        verdict = run_probe(
+            platform=os.environ.get("BENCH_PLATFORM", "auto"),
+            env={
+                k: v for k, v in os.environ.items()
+                if k != "TKNN_FAULTS" or "doctor" in v
+            },
+        )
+        _note(f"doctor preflight: {json.dumps(verdict)}")
+        preflight_ok = verdict["ok"]
+        if not preflight_ok:
+            _note("device failed preflight; skipping device series and "
+                  "walking the failure ladder")
+
+    banked_real = 0
+    failed = []  # failure docs, in series order
+    for i, overlay in enumerate(series):
+        label = _series_label(i, overlay)
+        env = _child_env(overlay)
+        if not preflight_ok:
+            # value = the series' own watchdog bound, the sentinel
+            # convention shared with the wedged path below ("would have
+            # taken at least this long"): a 0.0 here would poison any
+            # lower-is-better aggregation keyed on the series name
+            beat_b, wall_b = _series_timeouts(env)
+            failed.append({
+                "metric": metric_name(env),
+                "value": wall_b or beat_b or 0.0,
+                "unit": "s",
+                "vs_baseline": 0.0, "failed": True, "series": label,
+                "status": "preflight",
+            })
             continue
-        if "metric" in doc and "value" in doc:
-            # the fallback reports under an explicitly-marked series name:
-            # a reduced m alone is NOT collision-proof (a genuine
-            # BENCH_M=8000 TPU series would share "mnist8k_..."), so the
-            # marker is part of the name and the degraded number can never
-            # poison any primary series; vs_baseline stays 0 — a CPU
-            # number does not beat a TPU north star by definition
-            doc["metric"] = doc["metric"] + "_cpu_fallback"
-            doc["vs_baseline"] = 0.0
-            doc["degraded"] = "cpu-fallback"
-            doc["fallback_of"] = metric_name()
-            return doc
-    return None
-
-
-def _watchdog_fire():
-    # a wedged device transport hangs inside a native runtime call that
-    # never returns — a signal handler would never run (the interpreter
-    # can't regain control), so a daemon THREAD takes over: it banks a
-    # degraded CPU-mesh measurement from a fresh process when it can, and
-    # only then falls back to the honest failure line (vs_baseline 0)
-    # before hard-exiting instead of hanging the harness
-    if _COMPLETED.is_set():
-        return  # raced with a just-finished run: its success line stands
-    print(
-        json.dumps({"warning": "watchdog fired (wedged transport?); "
-                               "attempting CPU fallback measurement"}),
-        file=sys.stderr,
-        flush=True,
-    )
-    fallback = _cpu_fallback_line()
-    # check-and-print under the emit lock: the primary finishing during
-    # the minutes the fallback subprocess ran must not race us into a
-    # second stdout measurement line. os._exit below runs while the lock
-    # is held — a primary blocked on it dies with the process, before its
-    # duplicate print.
-    with _EMIT_LOCK:
-        if _COMPLETED.is_set():
-            return  # the primary finished while the fallback ran: it stands
-        if fallback is not None:
-            print(json.dumps(fallback), flush=True)
-            print(
-                json.dumps({
-                    "error": "watchdog: device unresponsive; banked a "
-                    "degraded cpu-fallback measurement instead",
-                    "fallback_metric": fallback["metric"],
-                }),
-                file=sys.stderr,
-                flush=True,
-            )
-            # the round banked a real (degraded, self-labeled) measurement
-            # — exit 0 so the harness records it instead of discarding it
-            os._exit(0)
-        watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", "480"))
-        print(
-            json.dumps(
-                {
-                    # same series name a successful run reports; value is
-                    # the timeout itself ("took at least this long") so
-                    # lower-is-better aggregations are not poisoned by a
-                    # negative sentinel
-                    "metric": metric_name(),
-                    "value": watchdog_s,
-                    "unit": "s",
-                    "vs_baseline": 0.0,
-                    "failed": True,
-                }
-            ),
-            flush=True,
+        beat_timeout, wall_timeout = _series_timeouts(env)
+        res = run_supervised(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            beat_timeout_s=beat_timeout,
+            wall_timeout_s=wall_timeout,
         )
-        print(
-            json.dumps({"error": "watchdog: device unresponsive (wedged "
-                                 "transport?); no measurement completed"}),
-            file=sys.stderr,
-            flush=True,
+        if res.stderr_tail:
+            # child context (its last '{'-line is the series' context
+            # object) — forwarded verbatim, supervisor notes stay non-JSON
+            sys.stderr.write(res.stderr_tail)
+            if not res.stderr_tail.endswith("\n"):
+                sys.stderr.write("\n")
+        doc = _measurement_line(res.stdout) if res.ok else None
+        if doc is not None:
+            # print the moment it is earned: a supervisor-level kill
+            # while a later series runs must not erase this one's signal
+            # (eager is safe — the fallback only ever REPLACES failure
+            # docs, and once one real line banked it never runs)
+            banked_real += 1
+            print(json.dumps(doc), flush=True)
+            _note(f"series {label!r}: banked {doc['metric']} = "
+                  f"{doc['value']}{doc['unit']}")
+            continue
+        if _is_usage_error(res):
+            _note(f"series {label!r}: usage error (exit 2) — not banked; "
+                  "fix the knobs")
+            continue
+        # wedged (beat starvation / wall kill) or crashed or silent-ok:
+        # a structured failed line under the series' real name, value =
+        # how long it ran ("took at least this long", so lower-is-better
+        # aggregations are not poisoned by a negative sentinel). Buffered,
+        # not printed: an all-failed round replaces these with the
+        # fallback's one real line.
+        status = res.status if res.status != "ok" else "crashed"
+        failed.append({
+            "metric": metric_name(env),
+            "value": round(res.duration_s, 1),
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "failed": True,
+            "series": label,
+            "status": status,
+        })
+        _note(
+            f"series {label!r}: {status}"
+            + (f" ({res.reason})" if res.reason else "")
+            + f" after {res.duration_s:.1f}s at beat {res.beats} "
+            f"{res.last_beat_label!r}; banked a failed line"
         )
-        os._exit(2)
+
+    if banked_real == 0 and failed:
+        fb = _cpu_fallback_line(failed[0]["metric"])
+        if fb is not None:
+            # the degraded line REPLACES the failed lines: the round
+            # banks one real (self-labeled) measurement instead of a
+            # pile of sentinels (PR 4's single-series behavior, kept)
+            print(json.dumps(fb), flush=True)
+            _note("no series banked; banked a degraded cpu-fallback "
+                  f"measurement instead ({fb['metric']})")
+            return 0
+    for doc in failed:
+        print(json.dumps(doc), flush=True)
+    if banked_real > 0:
+        return 0
+    if failed:
+        _note("no series banked a measurement (failed lines above)")
+    return 2
 
 
 if __name__ == "__main__":
-    # generous enough for first-compile (~40 s) + the run, tight enough
-    # that a wedged tunnel doesn't hang the harness forever
-    watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "480"))
-    t = None
-    if watchdog_s > 0:
-        t = threading.Timer(watchdog_s, _watchdog_fire)
-        t.daemon = True
-        t.start()
-    try:
-        rc = main()
-    finally:
-        # main sets _COMPLETED before printing its result line, so a timer
-        # that fires during the final prints is a no-op; cancel handles the
-        # not-yet-fired case (exception paths included)
-        if t is not None:
-            t.cancel()
-    sys.exit(rc)
+    if os.environ.get("TKNN_BENCH_CHILD") == "1":
+        sys.exit(main())
+    sys.exit(supervise())

@@ -17,12 +17,46 @@ Public API::
     pred   = knn_classify(result, labels, num_classes=10)
 """
 
-from mpi_knn_tpu.config import KNNConfig
-from mpi_knn_tpu.types import KNNResult
-from mpi_knn_tpu.api import all_knn, build_index, knn_classify, query_knn
-from mpi_knn_tpu.models.classifier import KNNClassifier
+import importlib
+import typing
+
+# Lazy (PEP 562) exports: the api/models modules import jax at load, but
+# the resilience supervisors (bench.py, `mpi-knn doctor`) import
+# `mpi_knn_tpu.resilience.*` from processes that must never touch a
+# (possibly wedged) device transport — `import mpi_knn_tpu.resilience`
+# executes THIS file, so the public API must not drag jax in eagerly.
+_EXPORTS = {
+    "KNNConfig": "mpi_knn_tpu.config",
+    "KNNResult": "mpi_knn_tpu.types",
+    "all_knn": "mpi_knn_tpu.api",
+    "build_index": "mpi_knn_tpu.api",
+    "query_knn": "mpi_knn_tpu.api",
+    "knn_classify": "mpi_knn_tpu.api",
+    "KNNClassifier": "mpi_knn_tpu.models.classifier",
+}
+
+if typing.TYPE_CHECKING:  # static analyzers see the eager imports
+    from mpi_knn_tpu.api import all_knn, build_index, knn_classify, query_knn
+    from mpi_knn_tpu.config import KNNConfig
+    from mpi_knn_tpu.models.classifier import KNNClassifier
+    from mpi_knn_tpu.types import KNNResult
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache: resolve once per process
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
 
 __all__ = [
     "KNNConfig",

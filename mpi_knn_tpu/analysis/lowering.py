@@ -53,9 +53,14 @@ LINT_M_MIXED, LINT_CORPUS_TILE_MIXED = 256, 32
 @dataclasses.dataclass(frozen=True)
 class LintTarget:
     """One cell of the backend × metric × dtype × precision-policy ×
-    ring-schedule × serve matrix (``schedule`` only varies for ring
-    backends; ``serve`` cells lint the per-batch program the serving
-    engine's executable cache compiles instead of the one-shot core)."""
+    ring-schedule × serve × ladder matrix (``schedule`` only varies for
+    ring backends; ``serve`` cells lint the per-batch program the serving
+    engine's executable cache compiles instead of the one-shot core;
+    ``ladder`` cells lint the program a degradation-ladder rung would
+    serve — ``"bucket"`` halves the row bucket, ``"nprobe"`` drops the
+    clustered probe count to 1 — so R5's donation contract and R2's
+    strict probed-bytes budget are re-certified on exactly what the
+    ladder lowers, retry paths introducing no new copies)."""
 
     backend: str
     metric: str
@@ -63,6 +68,7 @@ class LintTarget:
     policy: str = "exact"
     schedule: str = "uni"
     serve: bool = False
+    ladder: str = ""  # "" | "bucket" | "nprobe" — serve cells only
 
     @property
     def label(self) -> str:
@@ -73,6 +79,8 @@ class LintTarget:
             base = f"{base}/{self.schedule}"
         if self.serve:
             base = f"{base}/serve"
+        if self.ladder:
+            base = f"{base}/ladder-{self.ladder}"
         return base
 
 
@@ -124,6 +132,21 @@ def default_targets() -> list[LintTarget]:
         LintTarget("ivf", "l2", "float32", "mixed"),
         LintTarget("ivf", "l2", "float32", serve=True),
         LintTarget("ivf", "l2", "float32", "mixed", serve=True),
+    ] + [
+        # the degradation-ladder rung programs (resilience/ladder.py):
+        # under sustained deadline breach ServeSession serves smaller-
+        # nprobe / mixed / smaller-bucket cells of the SAME executable
+        # cache — the mixed rung is already certified by the mixed serve
+        # cells above; these add the bucket/2 rung (serial + ivf) and the
+        # nprobe→1 rung (ivf, where R2-strict's probed-bytes budget
+        # SHRINKS with the rung — the budget is re-derived from the rung
+        # cfg, so a rung program materializing more than its own smaller
+        # bound is a finding), each under R5's donation/no-corpus-copy
+        # contract: degrading must never cost the donation or introduce
+        # corpus-sized copies
+        LintTarget("serial", "l2", "float32", serve=True, ladder="bucket"),
+        LintTarget("ivf", "l2", "float32", serve=True, ladder="bucket"),
+        LintTarget("ivf", "l2", "float32", serve=True, ladder="nprobe"),
     ]
 
 
@@ -408,6 +431,13 @@ def _lower_serve(target: LintTarget):
     from mpi_knn_tpu.serve import build_index
     from mpi_knn_tpu.serve.engine import SCRATCH_PARAMS, lower_bucket
 
+    # degradation-ladder rung programs are ordinary cells of the same
+    # cache, lowered at the rung's knob values: the bucket/2 rung halves
+    # the row bucket, the nprobe rung probes a single partition (which
+    # also SHRINKS R2-strict's probed-bytes budget below — the rung must
+    # fit its own smaller bound, not ride on the full rung's)
+    bucket = LINT_NQ // 2 if target.ladder == "bucket" else LINT_NQ
+
     if target.backend == "ivf":
         # the clustered index serves through the SAME bucket cache; its
         # per-batch program is lowered via the production lower_bucket so
@@ -418,10 +448,12 @@ def _lower_serve(target: LintTarget):
                 "the clustered (IVF) path is l2/float32 by its own "
                 "contract (ivf/index.py rejects other combinations)"
             )
-        cfg = _ivf_cfg(target).replace(query_bucket=LINT_NQ, donate=True)
+        cfg = _ivf_cfg(target).replace(query_bucket=bucket, donate=True)
+        if target.ladder == "nprobe":
+            cfg = cfg.replace(nprobe=1)
         index = _ivf_lint_index(_ivf_cfg(target))
         cfg = index.compatible_cfg(cfg)
-        lowered, q_pad, q_tile = lower_bucket(index, cfg, LINT_NQ)
+        lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
         meta = {
             **_ivf_meta(index, cfg, q_tile),
             "serve": True,
@@ -445,11 +477,11 @@ def _lower_serve(target: LintTarget):
     # serving path resolves cfg.backend itself — pin it (the default
     # "auto" would quietly build every cell a ring-overlap index)
     cfg = _base_cfg(target).replace(
-        backend=target.backend, query_bucket=LINT_NQ, donate=True
+        backend=target.backend, query_bucket=bucket, donate=True
     )
     m = _lint_m(target)
     index = build_index(np.zeros((m, LINT_D), np.float32), cfg)
-    lowered, q_pad, q_tile = lower_bucket(index, index.cfg, LINT_NQ)
+    lowered, q_pad, q_tile = lower_bucket(index, index.cfg, bucket)
     meta = {
         "q_tile": q_tile,
         "c_tile": index.c_tile,

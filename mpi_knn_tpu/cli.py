@@ -267,6 +267,15 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.ivf.cli import main as build_index_main
 
         return build_index_main(argv[1:])
+    if argv and argv[0] == "doctor":
+        # preflight device-health subcommand: tiny jit + device_sync in a
+        # heartbeat-supervised subprocess (mpi_knn_tpu.resilience), JSON
+        # verdict on stdout, exit 0/1 — usable by operators before a
+        # serving run and by bench (BENCH_DOCTOR=1). Same routing
+        # pattern as lint/query/build-index.
+        from mpi_knn_tpu.resilience.doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.save_every is not None and args.save_every <= 0:

@@ -1,0 +1,79 @@
+"""Resilient execution layer (ISSUE 6 tentpole).
+
+A production serving stack is only production-shaped when hangs,
+transient faults, and overload degrade gracefully instead of wedging the
+whole process (TPU-KNN serves heavy traffic; Memory Safe Computations
+with XLA makes the same point for resource exhaustion — failures should
+be bounded and observable, not fatal). Four pieces, each importable
+without touching a device:
+
+- :mod:`~mpi_knn_tpu.resilience.heartbeat` — the progress-beat protocol
+  between a supervised worker subprocess and its supervisor;
+- :mod:`~mpi_knn_tpu.resilience.worker` — the isolated worker runner:
+  one unit of work per subprocess, killed on *beat starvation* (not just
+  wall-clock), always returning a structured ``ok``/``timeout``/
+  ``crashed`` result with captured output;
+- :mod:`~mpi_knn_tpu.resilience.faults` — env/config-driven fault
+  injection (hang, transient-exception-with-recovery, NaN poison, slow
+  batch) so every resilience path is exercised on CPU in tier-1 rather
+  than trusted;
+- :mod:`~mpi_knn_tpu.resilience.retry` / :mod:`~mpi_knn_tpu.resilience.
+  ladder` — bounded exponential-backoff retry and the serving
+  degradation ladder (smaller ``nprobe`` → ``precision_policy="mixed"``
+  → smaller bucket) that :class:`~mpi_knn_tpu.serve.engine.ServeSession`
+  walks under repeated deadline breach.
+
+``mpi-knn doctor`` (:mod:`~mpi_knn_tpu.resilience.doctor`) is the
+operator-facing preflight built on the worker runner.
+
+This module must stay importable with NO jax import at module load: the
+bench supervisor and the doctor supervisor run it in processes that must
+never touch a (possibly wedged) device transport.
+"""
+
+from mpi_knn_tpu.resilience.faults import (
+    TransientFault,
+    fault_point,
+    install_faults,
+    poison_topk,
+    reset_fault_state,
+)
+from mpi_knn_tpu.resilience.heartbeat import (
+    HEARTBEAT_ENV,
+    HeartbeatWriter,
+    maybe_beat,
+    read_beat,
+)
+from mpi_knn_tpu.resilience.ladder import (
+    PoisonedResultError,
+    ResiliencePolicy,
+    build_ladder,
+)
+from mpi_knn_tpu.resilience.retry import (
+    RetryExhausted,
+    RetryOutcome,
+    backoff_schedule,
+    retry_with_backoff,
+)
+from mpi_knn_tpu.resilience.worker import WorkerResult, run_supervised
+
+__all__ = [
+    "HEARTBEAT_ENV",
+    "HeartbeatWriter",
+    "PoisonedResultError",
+    "ResiliencePolicy",
+    "RetryExhausted",
+    "RetryOutcome",
+    "TransientFault",
+    "WorkerResult",
+    "backoff_schedule",
+    "build_ladder",
+    "fault_point",
+    "install_faults",
+    "maybe_beat",
+    "poison_topk",
+    "read_beat",
+    "reset_fault_state",
+    "retry_with_backoff",
+    "run_supervised",
+]

@@ -1,0 +1,158 @@
+"""``mpi-knn doctor`` — preflight device health probe.
+
+Answers one operator question before a bench round or a serving run:
+*will a tiny jitted program actually complete on this device, soon?* The
+probe (compile a small dot, run it, ``device_sync`` the result) runs in
+its OWN subprocess under the worker runner's heartbeat watchdog — a
+wedged transport wedges the probe child, never the caller — and the
+verdict is a single structured JSON line with exit status 0/1, so it
+slots into shell pipelines and the bench supervisor alike::
+
+    mpi-knn doctor                      # probe the default platform
+    mpi-knn doctor --platform cpu       # force a platform
+    mpi-knn doctor --timeout 30         # beat-starvation bound (s)
+    BENCH_DOCTOR=1 python bench.py      # bench runs it as preflight
+
+Verdict schema: ``{"ok": bool, "status": "ok"|"timeout"|"crashed",
+"probe": {platform, device_count, jit_probe_s} | null, "beats": N,
+"last_beat": label, "elapsed_s": s, "reason": str|null}``.
+
+The supervisor half of this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from mpi_knn_tpu.resilience.worker import python_worker_argv, run_supervised
+
+DEFAULT_BEAT_TIMEOUT_S = 60.0
+DEFAULT_WALL_TIMEOUT_S = 180.0
+
+
+def _probe_child(platform: str) -> int:
+    """The probe body, run inside the supervised worker subprocess: tiny
+    jit + device_sync under heartbeats. Beats bracket every step that can
+    hang so the supervisor's kill names the wedged step."""
+    from mpi_knn_tpu.resilience.faults import fault_point
+    from mpi_knn_tpu.resilience.heartbeat import maybe_beat
+
+    maybe_beat("start")
+    fault_point("doctor-probe")  # injectable wedge for tier-1
+    if platform != "auto":
+        from mpi_knn_tpu.utils.platform import force_platform
+
+        force_platform(platform)
+    maybe_beat("platform")
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_knn_tpu.utils.timing import device_sync
+
+    maybe_beat("jax-import")
+    devices = jax.devices()
+    maybe_beat("devices")
+    t0 = time.perf_counter()
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    y = jax.jit(lambda a: a @ a.T)(x)
+    device_sync(y)
+    probe_s = time.perf_counter() - t0
+    maybe_beat("jit")
+    print(
+        json.dumps(
+            {
+                "platform": jax.default_backend(),
+                "device_count": len(devices),
+                "jit_probe_s": round(probe_s, 4),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def run_probe(
+    platform: str = "auto",
+    beat_timeout_s: float = DEFAULT_BEAT_TIMEOUT_S,
+    wall_timeout_s: float = DEFAULT_WALL_TIMEOUT_S,
+    env: dict | None = None,
+) -> dict:
+    """Run the supervised probe and build the verdict document — shared
+    by the CLI below and the bench supervisor's ``BENCH_DOCTOR=1``
+    preflight (which must not print to its own stdout)."""
+    res = run_supervised(
+        python_worker_argv(
+            "-m", "mpi_knn_tpu", "doctor", "--child",
+            "--platform", platform,
+        ),
+        env=env,
+        beat_timeout_s=beat_timeout_s,
+        wall_timeout_s=wall_timeout_s,
+    )
+    probe = None
+    if res.ok:
+        for line in res.stdout.splitlines():
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict) and "device_count" in doc:
+                probe = doc
+    return {
+        "ok": bool(res.ok and probe is not None),
+        "status": res.status if probe is not None or not res.ok
+        else "crashed",  # rc 0 but no probe line = a broken child
+        "probe": probe,
+        "beats": res.beats,
+        "last_beat": res.last_beat_label,
+        "elapsed_s": round(res.duration_s, 3),
+        "reason": res.reason,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn doctor",
+        description="preflight device health probe (tiny jit + "
+        "device_sync in a heartbeat-supervised subprocess); exit 0 iff "
+        "healthy, JSON verdict on stdout",
+    )
+    p.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                   default="auto")
+    p.add_argument("--timeout", type=float,
+                   default=DEFAULT_BEAT_TIMEOUT_S,
+                   help="beat-starvation bound in seconds (progress "
+                   "gaps longer than this kill the probe)")
+    p.add_argument("--wall-timeout", type=float,
+                   default=DEFAULT_WALL_TIMEOUT_S,
+                   help="outer wall-clock bound in seconds")
+    p.add_argument("--report", default=None,
+                   help="also write the JSON verdict to this path")
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.child:
+        return _probe_child(args.platform)
+    verdict = run_probe(
+        platform=args.platform,
+        beat_timeout_s=args.timeout,
+        wall_timeout_s=args.wall_timeout,
+        env=dict(os.environ),
+    )
+    print(json.dumps(verdict), flush=True)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(verdict, f, indent=1)
+            f.write("\n")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""The heartbeat protocol between a supervised worker and its supervisor.
+
+Why beats and not wall-clock: the bench rounds that died (BENCH_r01/r03/
+r04/r05) were killed by a whole-process watchdog that could not tell "the
+device transport is wedged" from "the first compile is slow today", so it
+had to be generous — and when it finally fired, every series' signal was
+gone. A worker that WRITES MONOTONIC PROGRESS lets the supervisor kill on
+*beat starvation* (no progress for T seconds) instead: a wedged native
+call stops the beats immediately, while a slow-but-alive compile keeps
+them flowing. Wall-clock stays as the outer bound, not the diagnostic.
+
+Protocol: the worker overwrites one small JSON file (atomic tmp+rename)
+with ``{"seq": n, "label": ..., "pid": ...}`` — strictly increasing
+``seq``. The supervisor polls the file and tracks, on ITS OWN clock, when
+it last observed a new ``seq`` (the two processes' monotonic clocks are
+not comparable, so the child never writes a deadline — it writes
+progress, the supervisor judges it). A missing or torn file reads as "no
+beat yet": the file is the signal, never a crash source.
+
+Workers find the beat file via the ``TKNN_HEARTBEAT_FILE`` env var the
+supervisor sets; :func:`maybe_beat` is a no-op outside supervision, so
+instrumented code (bench series, the doctor probe) needs no mode flag.
+
+No jax import anywhere in this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+HEARTBEAT_ENV = "TKNN_HEARTBEAT_FILE"
+
+
+class HeartbeatWriter:
+    """Worker side: atomically overwrite the beat file with an increasing
+    sequence number. One writer per process; ``beat`` is cheap enough to
+    call per rep / per batch."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.seq = 0
+
+    def beat(self, label: str = "") -> int:
+        self.seq += 1
+        doc = {"seq": self.seq, "label": label, "pid": os.getpid()}
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".beat.", dir=d)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)  # atomic: readers never see a torn file
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self.seq
+
+
+_writer: HeartbeatWriter | None = None
+
+
+def maybe_beat(label: str = "") -> int | None:
+    """Beat iff this process runs under a supervisor (env var set);
+    silently a no-op otherwise, so instrumented code is unconditional."""
+    global _writer
+    path = os.environ.get(HEARTBEAT_ENV)
+    if not path:
+        return None
+    if _writer is None or _writer.path != path:
+        _writer = HeartbeatWriter(path)
+    return _writer.beat(label)
+
+
+def read_beat(path: str) -> dict | None:
+    """Supervisor side: the latest beat, or None (missing / torn file —
+    a beat in the middle of its atomic rename reads as the previous one,
+    never as garbage)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and "seq" in doc else None

@@ -21,6 +21,9 @@ Examples::
     mpi-knn query --data corpus.mat --queries q.npy --backend ring-overlap
     mpi-knn query --data sift:100000 --synthetic 10000 --bucket 1024 \
         --dispatch-depth 4 --report serve.json
+    mpi-knn query --data sift:100000 --synthetic 10000 \
+        --batch-deadline-ms 50 --retries 2    # resilient serving: deadline,
+        # transient-retry, NaN sentinel, degradation ladder (see --help)
 """
 
 from __future__ import annotations
@@ -112,12 +115,73 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--no-donate", action="store_true",
                    help="disable per-batch scratch donation (debugging)")
 
+    r = p.add_argument_group(
+        "resilience (mpi_knn_tpu.resilience: deadline, retry, sentinel, "
+        "degradation ladder)"
+    )
+    r.add_argument("--batch-deadline-ms", type=float, default=None,
+                   metavar="MS",
+                   help="per-batch latency deadline (dispatch→sync); on "
+                   "--degrade-after consecutive breaches the session "
+                   "sheds load one rung down the degradation ladder "
+                   "(smaller nprobe → mixed precision → smaller bucket), "
+                   "stamping every degraded batch in the records and the "
+                   "report")
+    r.add_argument("--retries", type=int, default=None, metavar="N",
+                   help="bounded exponential-backoff retries of a batch "
+                   "dispatch on transient failures (default 2 when a "
+                   "resilience policy is active)")
+    r.add_argument("--degrade-after", type=int, default=None, metavar="N",
+                   help="consecutive deadline breaches before shedding "
+                   "one ladder rung (default 2 when a resilience policy "
+                   "is active)")
+    r.add_argument("--no-nan-sentinel", action="store_true",
+                   help="disable the NaN/all-inf sentinel on returned "
+                   "top-k (on by default with a resilience policy; trips "
+                   "loudly with batch provenance)")
+
     o = p.add_argument_group("output")
     o.add_argument("--report", default=None, help="write JSON report here")
     o.add_argument("--platform", choices=["auto", "cpu", "tpu"],
                    default="auto")
     o.add_argument("-q", "--quiet", action="store_true")
     return p
+
+
+def _resilience_policy(args):
+    """A ResiliencePolicy when any resilience flag was given, else None
+    (the zero-overhead legacy session). A policy-shaping knob WITHOUT a
+    policy-activating one is refused, not silently inert — the serve
+    CLI's convention for knobs that would not apply."""
+    if args.degrade_after is not None and args.batch_deadline_ms is None:
+        # degradation is deadline-driven: without a deadline the counter
+        # can never trigger, whatever else is active
+        raise ValueError(
+            "--degrade-after without --batch-deadline-ms: degradation "
+            "is triggered by deadline breaches, so the knob would be "
+            "silently inert"
+        )
+    if args.batch_deadline_ms is None and args.retries is None:
+        if args.no_nan_sentinel:
+            raise ValueError(
+                "--no-nan-sentinel without --batch-deadline-ms or "
+                "--retries: no resilience policy is active, so the knob "
+                "would be silently inert"
+            )
+        return None
+    from mpi_knn_tpu.resilience import ResiliencePolicy
+
+    return ResiliencePolicy(
+        batch_deadline_s=(
+            args.batch_deadline_ms / 1e3
+            if args.batch_deadline_ms is not None else None
+        ),
+        max_retries=args.retries if args.retries is not None else 2,
+        degrade_after=(
+            args.degrade_after if args.degrade_after is not None else 2
+        ),
+        nan_sentinel=not args.no_nan_sentinel,
+    )
 
 
 def _load_query_stream(args, X):
@@ -167,6 +231,14 @@ def main(argv=None) -> int:
         print("error: --synthetic must be >= 1", file=sys.stderr)
         return 2
 
+    try:
+        policy = _resilience_policy(args)
+    except ValueError as e:
+        # invalid resilience knobs (negative deadline, degrade-after 0…):
+        # the loud exit-2 usage-error convention
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
 
@@ -178,7 +250,7 @@ def main(argv=None) -> int:
     X, _, source = load_corpus(args.data, limit=args.limit)
 
     if args.index_load:
-        return _serve_loaded_index(args, X, source)
+        return _serve_loaded_index(args, X, source, policy)
 
     if args.nprobe is not None:
         # the serve-CLI refusal convention: a probe count without a
@@ -213,7 +285,7 @@ def main(argv=None) -> int:
     t_build0 = time.perf_counter()
     try:
         index = build_index(X, cfg)
-        session = ServeSession(index)
+        session = ServeSession(index, resilience=policy)
     except ValueError as e:
         # the engine cannot honor this combination (pallas+cosine,
         # compressed index + mixed policy, blocking ring on a 2-D mesh…)
@@ -223,7 +295,7 @@ def main(argv=None) -> int:
     return _stream_and_report(args, session, index, X, source, build_s)
 
 
-def _serve_loaded_index(args, X, source) -> int:
+def _serve_loaded_index(args, X, source, policy=None) -> int:
     """``--index-load``: serve a saved clustered (IVF) index through the
     same session/bucket-cache machinery. Corpus-side knobs come from the
     saved index; explicitly conflicting flags are refused with the
@@ -300,7 +372,7 @@ def _serve_loaded_index(args, X, source) -> int:
                 donate=not args.no_donate,
             )
         )
-        session = ServeSession(index, cfg)
+        session = ServeSession(index, cfg, resilience=policy)
     except ValueError as e:
         # unhonorable combination (nprobe > partitions, mixed policy on a
         # bf16-at-rest index, …)
@@ -318,12 +390,29 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
 
     t0 = time.perf_counter()
     n_batches = 0
+    degraded_batches = 0
     for res in session.stream(stream):
         n_batches += 1
+        if res.degraded is not None:
+            degraded_batches += 1
         if not args.quiet:
+            # the per-batch resilience stamps ride the latency line: a
+            # degraded/retried/breached batch must be visible where the
+            # operator is already looking (the PR 4 "degraded" marker
+            # convention)
+            extra = ""
+            if res.degraded is not None:
+                extra += f" degraded={res.degraded}"
+            if res.retries:
+                extra += f" retries={res.retries}"
+            if res.deadline_breached:
+                extra += " DEADLINE-BREACH"
+            # res.seq IS the printed batch number: sentinel/degradation
+            # provenance (batch seq=N) must point at this exact line
             print(
-                f"batch {n_batches - 1}: rows={res.rows} "
+                f"batch {res.seq}: rows={res.rows} "
                 f"bucket={res.bucket} latency={res.latency_s * 1e3:.2f}ms"
+                f"{extra}"
             )
     wall = time.perf_counter() - t0
 
@@ -351,6 +440,22 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         summary["probe_fraction"] = round(
             cfg.nprobe / index.partitions, 4
         )
+    if session.policy is not None:
+        # the degradation story, summarized where the round is read: how
+        # often the deadline broke, what the ladder shed, where serving
+        # ended up — mirroring the per-batch stamps above
+        summary["resilience"] = {
+            "batch_deadline_ms": (
+                session.policy.batch_deadline_s * 1e3
+                if session.policy.batch_deadline_s is not None else None
+            ),
+            "ladder": [label for label, _ in session.ladder],
+            "final_rung": session.rung,
+            "degraded_batches": degraded_batches,
+            "deadline_breaches": session.deadline_breaches,
+            "retries_total": session.retries_total,
+            "degradations": session.degradations,
+        }
     if not args.quiet:
         print(
             f"[mpi-knn query] {summary['queries']} queries in "

@@ -29,6 +29,18 @@ flight; at depth ≥ 2 batch t+1's H2D transfer and dispatch overlap batch
 t's device compute (double buffering). Timing is honest per the
 BASELINE.md methodology — a batch is only timed when ``device_sync`` has
 forced its result to materialize, never at dispatch.
+
+Why resilience lives here (ISSUE 6): a serving stack is only
+production-shaped when hangs, transient faults, and overload degrade
+gracefully. ``ServeSession`` optionally takes a
+:class:`~mpi_knn_tpu.resilience.ladder.ResiliencePolicy`: per-batch
+deadline, bounded-backoff retry of transient dispatch failures, a
+NaN/all-inf sentinel on every retired top-k, and an explicit degradation
+ladder (smaller ``nprobe`` → ``precision_policy="mixed"`` → smaller
+bucket) walked on repeated deadline breach — every rung an ordinary
+(bucket, config) cell of this cache, every degradation stamped into the
+per-batch records. The fault-injection hooks
+(``mpi_knn_tpu/resilience/faults.py``) make all of it testable on CPU.
 """
 
 from __future__ import annotations
@@ -46,6 +58,14 @@ from jax.sharding import NamedSharding
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.topk import init_topk, init_topk_tiles, merge_topk
 from mpi_knn_tpu.parallel.partition import pad_rows_any, pad_to_multiple
+from mpi_knn_tpu.resilience.faults import fault_point, poison_topk
+from mpi_knn_tpu.resilience.ladder import (
+    FULL_RUNG,
+    PoisonedResultError,
+    ResiliencePolicy,
+    build_ladder,
+)
+from mpi_knn_tpu.resilience.retry import retry_with_backoff
 from mpi_knn_tpu.serve.index import CorpusIndex
 from mpi_knn_tpu.types import KNNResult
 from mpi_knn_tpu.utils.timing import device_sync
@@ -460,13 +480,27 @@ class BatchResult:
     """One served batch: padded device results plus the real row count.
     ``dists``/``ids`` strip the padding on host (no per-raw-size device
     program in the steady-state path), fetching the device buffer once —
-    repeated attribute access must not re-pay the padded D2H transfer."""
+    repeated attribute access must not re-pay the padded D2H transfer.
+
+    The resilience fields are the per-batch record the degradation
+    machinery stamps (``None``/zero when the session has no policy):
+    ``degraded`` names the ladder rung the batch was DISPATCHED under
+    (``None`` = the configured full rung — the PR 4 ``"degraded"`` marker
+    convention), ``retries``/``backoffs`` the transient-failure retry
+    story, and ``deadline_breached`` whether this batch's measured
+    latency overran the policy's per-batch deadline."""
 
     dists_padded: jax.Array
     ids_padded: jax.Array
     rows: int
     bucket: int
     latency_s: float | None = None  # filled by the session at sync time
+    seq: int = 0  # 0-indexed session-order batch number (provenance —
+    # the same number the serve CLI prints on the batch's latency line)
+    degraded: str | None = None  # ladder rung label, None = full
+    retries: int = 0
+    backoffs: tuple = ()
+    deadline_breached: bool = False
 
     @functools.cached_property
     def dists(self) -> np.ndarray:
@@ -521,34 +555,129 @@ class ServeSession:
     ``latencies``/``queries_served`` accumulate until ``reset_stats()``:
     a long-lived server should reset per reporting window (one float per
     batch adds up over millions of batches).
+
+    With a :class:`~mpi_knn_tpu.resilience.ladder.ResiliencePolicy` the
+    session additionally enforces a per-batch deadline (measured at
+    retire — the same dispatch→sync latency it already reports), retries
+    transiently-failing dispatches with bounded exponential backoff,
+    trips a NaN/all-inf sentinel on every retired batch's top-k (loudly:
+    :class:`PoisonedResultError` with full batch provenance), and on
+    ``degrade_after`` CONSECUTIVE deadline breaches sheds load one rung
+    down the explicit degradation ladder (smaller ``nprobe`` →
+    ``precision_policy="mixed"`` → smaller bucket — see
+    ``resilience/ladder.py`` for why each rung is recall-safe). Every
+    rung is an ordinary (bucket, config) cell of the executable cache;
+    every degradation is stamped into the batch records
+    (``BatchResult.degraded``) and the ``degradations`` event list.
+    ``resilience=None`` (default) is the zero-overhead legacy behavior.
     """
 
     def __init__(
         self,
         index: CorpusIndex,
         config: KNNConfig | None = None,
+        resilience: ResiliencePolicy | None = None,
         **overrides,
     ):
         self.index = index
         self.cfg = index.compatible_cfg(
             (config or index.cfg).replace(**overrides)
         )
+        self.policy = resilience
+        if resilience is not None:
+            self.ladder = build_ladder(index, self.cfg, resilience)
+        else:
+            self.ladder = [(FULL_RUNG, self.cfg)]
+        self._rung = 0
+        self._consecutive_breaches = 0
+        self._seq = 0
         self._inflight: collections.deque = collections.deque()
         self.latencies: list[float] = []
         self.queries_served = 0
+        self.degradations: list[dict] = []  # rung-shed events, in order
+        self.retries_total = 0
+        self.deadline_breaches = 0
+
+    @property
+    def rung(self) -> str:
+        """The ladder rung new submissions dispatch under."""
+        return self.ladder[self._rung][0]
 
     def warm(self, sizes) -> None:
-        """Pre-compile the executables for the given batch sizes."""
+        """Pre-compile the executables for the given batch sizes — at
+        EVERY ladder rung, not just the configured one: the first batch
+        after a degradation lands at the moment of overload, and a cold
+        compile there would itself breach the deadline and cascade the
+        session further down the ladder on compile latency, not load.
+        (Rungs whose program coincides with an already-compiled cell —
+        a halved bucket that pads a given size to the same row count —
+        hit the cache and cost nothing.)"""
         for n in sizes:
-            get_executable(
-                self.index, self.cfg, bucket_rows(n, self.cfg.query_bucket)
-            )
+            for _, cfg in self.ladder:
+                get_executable(
+                    self.index, cfg, bucket_rows(n, cfg.query_bucket)
+                )
 
     def reset_stats(self) -> None:
         """Start a fresh measurement window (in-flight batches keep their
         dispatch timestamps and will land in the new window)."""
         self.latencies = []
         self.queries_served = 0
+        self.retries_total = 0
+        self.deadline_breaches = 0
+
+    def _check_sentinel(self, res: BatchResult) -> None:
+        """NaN/all-inf sentinel on a retired batch's REAL rows. NaN in a
+        top-k distance has exactly one source — a poisoned distance tile
+        (fp distances are sums of squares; the masks use +inf) — and an
+        all-inf row means every candidate was masked away. Neither may be
+        returned as an answer or dropped silently: trip loudly, with the
+        provenance an operator needs to find the batch."""
+        d = res.dists  # strips padding; cached, so retire pays D2H once
+        bad_nan = bool(np.isnan(d).any())
+        bad_inf = bool(d.size) and bool(np.isinf(d).all(axis=1).any())
+        if bad_nan or bad_inf:
+            kind = "NaN" if bad_nan else "all-inf row"
+            raise PoisonedResultError(
+                f"poisoned top-k ({kind}) in served batch seq={res.seq} "
+                f"bucket={res.bucket} rows={res.rows} "
+                f"rung={res.degraded or FULL_RUNG}",
+                batch_seq=res.seq,
+                bucket=res.bucket,
+                rung=res.degraded or FULL_RUNG,
+                rows=res.rows,
+            )
+
+    def _note_latency(self, res: BatchResult) -> None:
+        """Deadline accounting at retire time: count CONSECUTIVE breaches
+        and shed one ladder rung when the policy's patience runs out. A
+        single slow batch (compile, GC pause) never degrades; a breach
+        streak does, and the event is recorded. Retry backoff sleeps are
+        EXCLUDED from the comparison (``latency_s`` itself stays the
+        honest dispatch→sync total): backoff is self-inflicted waiting on
+        a transient fault, not load — counting it would let two transport
+        blips walk the one-way ladder and spend recall on a problem the
+        ladder's smaller programs cannot fix."""
+        pol = self.policy
+        if pol is None or pol.batch_deadline_s is None:
+            return
+        if res.latency_s - sum(res.backoffs) <= pol.batch_deadline_s:
+            self._consecutive_breaches = 0
+            return
+        res.deadline_breached = True
+        self.deadline_breaches += 1
+        self._consecutive_breaches += 1
+        if (
+            self._consecutive_breaches >= pol.degrade_after
+            and self._rung < len(self.ladder) - 1
+        ):
+            self._rung += 1
+            self._consecutive_breaches = 0
+            self.degradations.append({
+                "after_batch": res.seq,
+                "rung": self.ladder[self._rung][0],
+                "breaches": self.deadline_breaches,
+            })
 
     def _retire(self) -> BatchResult:
         res, t0 = self._inflight.popleft()
@@ -556,15 +685,49 @@ class ServeSession:
         res.latency_s = time.perf_counter() - t0
         self.latencies.append(res.latency_s)
         self.queries_served += res.rows
+        self._note_latency(res)
+        if self.policy is not None and self.policy.nan_sentinel:
+            self._check_sentinel(res)
         return res
+
+    def _dispatch(self, queries, cfg: KNNConfig):
+        """One dispatch attempt under ``cfg`` (a ladder rung's config).
+        The fault site models a transient transport failure; the poison
+        hook injects a NaN into the returned tile for sentinel tests."""
+        fault_point("serve-batch")
+        bucket = bucket_rows(queries.shape[0], cfg.query_bucket)
+        exec_ = get_executable(self.index, cfg, bucket)
+        q2d, qids, rows = _prep_queries(self.index, cfg, exec_, queries)
+        d, i = _run(self.index, cfg, exec_, q2d, qids)
+        return bucket, rows, poison_topk(d), i
 
     def submit(self, queries) -> list[BatchResult]:
         t0 = time.perf_counter()
-        bucket = bucket_rows(queries.shape[0], self.cfg.query_bucket)
-        exec_ = get_executable(self.index, self.cfg, bucket)
-        q2d, qids, rows = _prep_queries(self.index, self.cfg, exec_, queries)
-        d, i = _run(self.index, self.cfg, exec_, q2d, qids)
-        self._inflight.append((BatchResult(d, i, rows, bucket), t0))
+        label, cfg = self.ladder[self._rung]
+        pol = self.policy
+        if pol is not None and pol.max_retries > 0:
+            out = retry_with_backoff(
+                lambda: self._dispatch(queries, cfg),
+                retries=pol.max_retries,
+                base_s=pol.backoff_base_s,
+                max_s=pol.backoff_max_s,
+                retryable=pol.retryable,
+            )
+            bucket, rows, d, i = out.value
+            retries, backoffs = out.attempts - 1, out.backoffs
+            self.retries_total += retries
+        else:
+            bucket, rows, d, i = self._dispatch(queries, cfg)
+            retries, backoffs = 0, ()
+        res = BatchResult(
+            d, i, rows, bucket,
+            seq=self._seq,  # 0-indexed, matching the CLI's printed lines
+            degraded=None if label == FULL_RUNG else label,
+            retries=retries,
+            backoffs=backoffs,
+        )
+        self._seq += 1
+        self._inflight.append((res, t0))
         done = []
         # bound the dispatch-ahead window: at depth d, batch t+d-1 may be
         # prepared/dispatched while batch t is still in flight; depth 1

@@ -64,8 +64,29 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # that corpus payload reaches a dot only through the per-query probe
 # gather and R2 runs in STRICT mode (the probed-bytes bound
 # nprobe·bucket_cap·d replaces the largest-input floor — the sublinear
-# claim as a compiled-program fact); any finding fails the gate
+# claim as a compiled-program fact) — PLUS the degradation-ladder cells
+# (ladder-bucket on serial+ivf, ladder-nprobe on ivf): R5 re-certifies
+# the donation/no-corpus-copy contract on exactly the programs
+# resilience/ladder.py's rungs lower under sustained deadline breach
+# (degrading, and the retry paths around it, must introduce no new
+# copies), and the nprobe rung must fit R2-strict's SMALLER probed-bytes
+# budget; any finding fails the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
+
+note "fault-injection / resilience suite (ISSUE 6 gate)"
+# the resilience layer's whole fault matrix, exercised on CPU rather than
+# trusted: injected hang → heartbeat-starvation kill with a structured
+# timeout result; transient fault → success-after-N with the exact
+# backoff sequence; NaN poison → sentinel trips with batch provenance;
+# injected deadline breaches → the serving degradation ladder walks with
+# recall gated at each rung's own bar. The bench/doctor subprocess
+# regressions (partial-round banking, the BENCH_r05 shape) run here too —
+# this is a named gate so a resilience regression is called out by name,
+# not buried in the tier-1 roll-up (the file runs again there; it is
+# ~35 s, cheap enough to pay twice for the naming)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_resilience.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || fail=1
 
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log

@@ -628,11 +628,17 @@ def test_default_ivf_lint_cells_are_clean():
     """The positive lint criterion: every default ivf cell lowers and
     passes all applicable rules — R6 and strict-R2 run on every one (zero
     batched dots or an over-budget buffer would be findings, so 'ok' is
-    non-vacuous), R5 on the serve cells."""
+    non-vacuous), R5 on the serve cells. The set includes the two
+    degradation-ladder cells (ladder-bucket, ladder-nprobe — the programs
+    resilience/ladder.py's rungs serve under deadline breach; the nprobe
+    rung must fit R2-strict's SMALLER probed-bytes budget)."""
     from mpi_knn_tpu.analysis import engine, lowering
 
     targets = [t for t in lowering.default_targets() if t.backend == "ivf"]
-    assert len(targets) == 4, targets
+    assert len(targets) == 6, targets
+    assert sorted(t.ladder for t in targets) == [
+        "", "", "", "", "bucket", "nprobe",
+    ]
     for t in targets:
         res = engine.lint_target(t)
         assert res.skipped is None, (t.label, res.skipped)
