@@ -15,7 +15,13 @@ the failure modes that erased 4 of 5 r5 rounds (whole-process watchdog,
 
 - a completed series banks its real measurement line, always;
 - a wedged/crashed series banks a structured ``"failed": true`` line
-  under its own series name (value = how long it ran before the kill);
+  under its own series name — with ``"value": null`` and the kill time
+  in an explicit ``time_until_kill_s`` field, never a ``vs_baseline``
+  number (BENCH_r05 banked a watchdog timeout as ``value: 480.0,
+  vs_baseline: 0.0`` — a timeout stamped as a zero-regression
+  measurement; ISSUE 7), plus the child's banked span flight record
+  (``mpi_knn_tpu.obs.spans``) so the round keeps the story of where the
+  time went;
 - the process exits 0 whenever at least one series banked;
 - only when NO series banked anything does the round fall to the last
   rung of the ladder: a serial/CPU re-run in a fresh subprocess at
@@ -125,6 +131,7 @@ def main() -> int:
     hang, so the supervisor's beat-starvation kill names the wedged
     step; the injectable ``bench-series`` fault site stands in for a
     wedged transport in tier-1."""
+    from mpi_knn_tpu.obs.spans import span as flight_span
     from mpi_knn_tpu.resilience.faults import fault_point
     from mpi_knn_tpu.resilience.heartbeat import maybe_beat
 
@@ -328,15 +335,17 @@ def main() -> int:
             index, X, qids, rcfg
         )
         device_sync(q_tiles)
-        d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)  # warm
-        device_sync(d, i)
+        with flight_span("warm", cat="bench", backend="ivf"):
+            d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)  # warm
+            device_sync(d, i)
         maybe_beat("warm")
         times = []
         for r in range(reps):
-            t0 = time.perf_counter()
-            d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)
-            device_sync(d, i)
-            times.append(time.perf_counter() - t0)
+            with flight_span("rep", cat="bench", rep=r):
+                t0 = time.perf_counter()
+                d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)
+                device_sync(d, i)
+                times.append(time.perf_counter() - t0)
             maybe_beat(f"rep{r}")
         got_ids = np.asarray(
             jax.device_get(i)
@@ -348,16 +357,18 @@ def main() -> int:
         device_sync(Xd)
 
         # compile + warm up
-        result = all_knn(Xd, config=cfg)
-        device_sync(result.dists)
+        with flight_span("warm", cat="bench", backend=backend):
+            result = all_knn(Xd, config=cfg)
+            device_sync(result.dists)
         maybe_beat("warm")
 
         times = []
         for r in range(reps):
-            t0 = time.perf_counter()
-            result = all_knn(Xd, config=cfg)
-            device_sync(result.dists, result.ids)
-            times.append(time.perf_counter() - t0)
+            with flight_span("rep", cat="bench", rep=r):
+                t0 = time.perf_counter()
+                result = all_knn(Xd, config=cfg)
+                device_sync(result.dists, result.ids)
+                times.append(time.perf_counter() - t0)
             maybe_beat(f"rep{r}")
     # median is the headline (VERDICT r1 #9): honest under transport noise;
     # min stays visible on stderr for best-case comparisons
@@ -478,6 +489,32 @@ def _measurement_line(stdout: str):
     return found
 
 
+def _failed_line(metric: str, series: str, status: str,
+                 time_until_kill_s: float | None = None,
+                 flight: dict | None = None) -> dict:
+    """The structured line a failed series banks (ISSUE 7 shape):
+    ``value`` is null — a watchdog kill is NOT a measurement, and
+    BENCH_r05 proved a numeric value here gets read as one (the timeout
+    banked as ``value: 480.0, vs_baseline: 0.0``, a kill stamped as a
+    zero-regression data point). The kill time lives in the explicit
+    ``time_until_kill_s`` field instead, the line NEVER carries
+    ``vs_baseline``, and the child's span flight-record summary (open
+    spans name the step the kill interrupted) rides along when the
+    worker recorded one."""
+    doc = {
+        "metric": metric,
+        "value": None,
+        "unit": "s",
+        "failed": True,
+        "series": series,
+        "status": status,
+        "time_until_kill_s": time_until_kill_s,
+    }
+    if flight is not None:
+        doc["flight"] = flight
+    return doc
+
+
 def _is_usage_error(res) -> bool:
     """A child that refused its knobs (loud exit-2 convention): a
     configuration bug, not a device failure — it must NOT be banked as a
@@ -593,18 +630,11 @@ def supervise() -> int:
         label = _series_label(i, overlay)
         env = _child_env(overlay)
         if not preflight_ok:
-            # value = the series' own watchdog bound, the sentinel
-            # convention shared with the wedged path below ("would have
-            # taken at least this long"): a 0.0 here would poison any
-            # lower-is-better aggregation keyed on the series name
-            beat_b, wall_b = _series_timeouts(env)
-            failed.append({
-                "metric": metric_name(env),
-                "value": wall_b or beat_b or 0.0,
-                "unit": "s",
-                "vs_baseline": 0.0, "failed": True, "series": label,
-                "status": "preflight",
-            })
+            # the series never started: 0 s until the (preflight) kill
+            failed.append(_failed_line(
+                metric_name(env), label, "preflight",
+                time_until_kill_s=0.0,
+            ))
             continue
         beat_timeout, wall_timeout = _series_timeouts(env)
         res = run_supervised(
@@ -635,21 +665,16 @@ def supervise() -> int:
                   "fix the knobs")
             continue
         # wedged (beat starvation / wall kill) or crashed or silent-ok:
-        # a structured failed line under the series' real name, value =
-        # how long it ran ("took at least this long", so lower-is-better
-        # aggregations are not poisoned by a negative sentinel). Buffered,
+        # a structured failed line under the series' real name, with the
+        # banked flight record telling where the time went. Buffered,
         # not printed: an all-failed round replaces these with the
         # fallback's one real line.
         status = res.status if res.status != "ok" else "crashed"
-        failed.append({
-            "metric": metric_name(env),
-            "value": round(res.duration_s, 1),
-            "unit": "s",
-            "vs_baseline": 0.0,
-            "failed": True,
-            "series": label,
-            "status": status,
-        })
+        failed.append(_failed_line(
+            metric_name(env), label, status,
+            time_until_kill_s=round(res.duration_s, 1),
+            flight=res.flight,
+        ))
         _note(
             f"series {label!r}: {status}"
             + (f" ({res.reason})" if res.reason else "")

@@ -17,6 +17,8 @@ Examples::
     python -m mpi_knn_tpu query --data sift:100000 --index-load sift.ivf.npz \
         --synthetic 4096                         # sublinear serving
     python -m mpi_knn_tpu lint --serve                     # static analysis
+    python -m mpi_knn_tpu metrics serve-metrics.json       # observability:
+    python -m mpi_knn_tpu metrics --flight flight.jsonl --chrome trace.json
 """
 
 from __future__ import annotations
@@ -267,6 +269,14 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.ivf.cli import main as build_index_main
 
         return build_index_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        # observability subcommand: render/check metrics snapshots and
+        # span flight records (mpi_knn_tpu.obs) — jax-free, so it works
+        # in supervisor processes and shell pipelines. Same routing
+        # pattern as lint/query/build-index.
+        from mpi_knn_tpu.obs.cli import main as metrics_main
+
+        return metrics_main(argv[1:])
     if argv and argv[0] == "doctor":
         # preflight device-health subcommand: tiny jit + device_sync in a
         # heartbeat-supervised subprocess (mpi_knn_tpu.resilience), JSON

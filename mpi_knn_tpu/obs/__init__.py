@@ -1,0 +1,82 @@
+"""Unified observability layer (ISSUE 7 tentpole) — three pillars, one
+place every perf claim reads its evidence from:
+
+- :mod:`~mpi_knn_tpu.obs.metrics` — the process-wide metrics registry
+  (counters / gauges / fixed-bucket histograms with deterministic,
+  assertable percentiles), the central ``jax.monitoring`` compile
+  capture, and JSON + Prometheus text exposition;
+- :mod:`~mpi_knn_tpu.obs.spans` — the span flight recorder: structured
+  trace spans (index build, per-bucket compile, per-batch
+  dispatch→retire, retry/backoff, ladder rung changes, heartbeats)
+  appended incrementally to a JSONL ring file so a SIGKILLed worker's
+  flight record survives, plus schema validation and a Chrome
+  trace-event (Perfetto) exporter;
+- :mod:`~mpi_knn_tpu.obs.xplane` / :mod:`~mpi_knn_tpu.obs.attribution`
+  — the ``.xplane.pb`` wire-format parser as a library and the
+  per-category device-time split (matmul / sort-topk / collective /
+  copy / other + collective-under-compute overlap fraction) the serve
+  report embeds next to its p50/p99.
+
+``mpi-knn metrics`` (:mod:`~mpi_knn_tpu.obs.cli`) renders, validates,
+and exports these artifacts.
+
+Like :mod:`mpi_knn_tpu.resilience`, this package is importable with NO
+jax import at module load (lazy PEP-562 exports): the bench/doctor
+supervisors read flight records and metrics snapshots in processes that
+must never touch a device transport. Only
+:func:`~mpi_knn_tpu.obs.metrics.install_jax_compile_listener` (and the
+attribution of a trace some jax process wrote) involves jax, and only
+at call time.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    # metrics
+    "Counter": "mpi_knn_tpu.obs.metrics",
+    "Gauge": "mpi_knn_tpu.obs.metrics",
+    "Histogram": "mpi_knn_tpu.obs.metrics",
+    "MetricsRegistry": "mpi_knn_tpu.obs.metrics",
+    "get_registry": "mpi_knn_tpu.obs.metrics",
+    "install_jax_compile_listener": "mpi_knn_tpu.obs.metrics",
+    "watch_compiles": "mpi_knn_tpu.obs.metrics",
+    "to_prometheus": "mpi_knn_tpu.obs.metrics",
+    "parse_prometheus": "mpi_knn_tpu.obs.metrics",
+    # spans
+    "FlightRecorder": "mpi_knn_tpu.obs.spans",
+    "RECORDER_ENV": "mpi_knn_tpu.obs.spans",
+    "get_recorder": "mpi_knn_tpu.obs.spans",
+    "set_recorder": "mpi_knn_tpu.obs.spans",
+    "span": "mpi_knn_tpu.obs.spans",
+    "event": "mpi_knn_tpu.obs.spans",
+    "begin_span": "mpi_knn_tpu.obs.spans",
+    "end_span": "mpi_knn_tpu.obs.spans",
+    "read_flight": "mpi_knn_tpu.obs.spans",
+    "reconstruct_spans": "mpi_knn_tpu.obs.spans",
+    "summarize_flight": "mpi_knn_tpu.obs.spans",
+    "validate_flight": "mpi_knn_tpu.obs.spans",
+    "to_chrome_trace": "mpi_knn_tpu.obs.spans",
+    # xplane / attribution
+    "ParseError": "mpi_knn_tpu.obs.xplane",
+    "parse_xplane": "mpi_knn_tpu.obs.xplane",
+    "parse_xplane_bytes": "mpi_knn_tpu.obs.xplane",
+    "find_xplanes": "mpi_knn_tpu.obs.xplane",
+    "analyze": "mpi_knn_tpu.obs.xplane",
+    "categorize": "mpi_knn_tpu.obs.xplane",
+    "attribute_trace": "mpi_knn_tpu.obs.attribution",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

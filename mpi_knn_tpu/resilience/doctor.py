@@ -14,8 +14,10 @@ slots into shell pipelines and the bench supervisor alike::
     BENCH_DOCTOR=1 python bench.py      # bench runs it as preflight
 
 Verdict schema: ``{"ok": bool, "status": "ok"|"timeout"|"crashed",
-"probe": {platform, device_count, jit_probe_s} | null, "beats": N,
-"last_beat": label, "elapsed_s": s, "reason": str|null}``.
+"probe": {platform, device_count, jit_probe_s} | null,
+"metrics": <obs registry snapshot with the probe's compile count/
+duration> | null, "beats": N, "last_beat": label, "elapsed_s": s,
+"reason": str|null, "flight": <banked span summary> | null}``.
 
 The supervisor half of this module never imports jax.
 """
@@ -51,8 +53,15 @@ def _probe_child(platform: str) -> int:
     import jax
     import jax.numpy as jnp
 
+    from mpi_knn_tpu.obs.metrics import (
+        get_registry,
+        install_jax_compile_listener,
+    )
     from mpi_knn_tpu.utils.timing import device_sync
 
+    # the verdict's metrics snapshot must capture the probe's own
+    # compile, so the listener goes live before the jit below
+    install_jax_compile_listener()
     maybe_beat("jax-import")
     devices = jax.devices()
     maybe_beat("devices")
@@ -72,6 +81,11 @@ def _probe_child(platform: str) -> int:
         ),
         flush=True,
     )
+    # second stdout line: the probe's registry snapshot (compile count +
+    # duration histogram via the central jax.monitoring capture) — the
+    # supervisor folds it into the verdict as hard evidence the device
+    # compiled and ran SOMETHING, not just that the process exited 0
+    print(json.dumps({"metrics": get_registry().snapshot()}), flush=True)
     return 0
 
 
@@ -94,6 +108,7 @@ def run_probe(
         wall_timeout_s=wall_timeout_s,
     )
     probe = None
+    metrics = None
     if res.ok:
         for line in res.stdout.splitlines():
             try:
@@ -102,15 +117,23 @@ def run_probe(
                 continue
             if isinstance(doc, dict) and "device_count" in doc:
                 probe = doc
+            elif isinstance(doc, dict) and "metrics" in doc:
+                metrics = doc["metrics"]
     return {
         "ok": bool(res.ok and probe is not None),
         "status": res.status if probe is not None or not res.ok
         else "crashed",  # rc 0 but no probe line = a broken child
         "probe": probe,
+        # the child registry's snapshot (jax_compiles_total + duration
+        # histogram): the probe's compile, centrally counted (ISSUE 7)
+        "metrics": metrics,
         "beats": res.beats,
         "last_beat": res.last_beat_label,
         "elapsed_s": round(res.duration_s, 3),
         "reason": res.reason,
+        # a killed probe's span story (open spans name the wedged step,
+        # complementing last_beat)
+        "flight": res.flight,
     }
 
 

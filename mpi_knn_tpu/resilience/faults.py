@@ -22,7 +22,8 @@ Faults are armed two ways, identically expressive:
 Kinds:
 
 - ``hang`` — block forever (sleep loop; killable, uninterruptible by the
-  caller) — the wedged-transport stand-in;
+  caller) — the wedged-transport stand-in; ``hang:N`` lets the first
+  N−1 hits of the site pass and hangs on the N-th (worked-then-wedged);
 - ``transient:N`` — raise :class:`TransientFault` on the first N hits of
   the site, then succeed (the retry/backoff path's success-after-N);
 - ``slow:S`` — sleep S seconds (deadline-breach injection);
@@ -142,7 +143,10 @@ def fault_point(site: str) -> None:
     """Instrumented call site: act on the fault armed for ``site``.
 
     - hang: never returns (the supervisor's beat-starvation kill is the
-      only way out — exactly the wedged-transport shape);
+      only way out — exactly the wedged-transport shape); ``hang:N``
+      passes the first N−1 hits and hangs on the N-th — the
+      "worked-then-wedged" shape the flight-recorder tests need (a few
+      clean batch spans, then an open one at the kill);
     - transient:N: raises :class:`TransientFault` for the first N hits;
     - slow:S: sleeps S seconds, then returns;
     - nan: no-op here (value faults act at :func:`poison_topk`).
@@ -151,6 +155,8 @@ def fault_point(site: str) -> None:
     if spec is None:
         return
     if spec.kind == "hang":
+        if spec.arg and _hit(site) < int(spec.arg):
+            return
         while True:  # killable sleep loop, not one unbounded syscall
             time.sleep(0.25)
     if spec.kind == "transient":

@@ -65,14 +65,25 @@ _writer: HeartbeatWriter | None = None
 
 def maybe_beat(label: str = "") -> int | None:
     """Beat iff this process runs under a supervisor (env var set);
-    silently a no-op otherwise, so instrumented code is unconditional."""
+    silently a no-op otherwise, so instrumented code is unconditional.
+    Every beat is mirrored into the span flight recorder (when one is
+    active) so the trace timeline carries the same progress marks the
+    supervisor judged — a killed worker's record shows exactly which
+    beat was its last (ISSUE 7)."""
     global _writer
+    seq = None
     path = os.environ.get(HEARTBEAT_ENV)
-    if not path:
-        return None
-    if _writer is None or _writer.path != path:
-        _writer = HeartbeatWriter(path)
-    return _writer.beat(label)
+    if path:
+        if _writer is None or _writer.path != path:
+            _writer = HeartbeatWriter(path)
+        seq = _writer.beat(label)
+    # obs.spans is as jax-free as this module; event() no-ops without an
+    # active recorder, mirroring the beat no-op above
+    from mpi_knn_tpu.obs.spans import event as _flight_event
+
+    _flight_event("beat", cat="heartbeat", label=label,
+                  **({"seq": seq} if seq is not None else {}))
+    return seq
 
 
 def read_beat(path: str) -> dict | None:

@@ -27,6 +27,7 @@ import sys
 import tempfile
 import time
 
+from mpi_knn_tpu.obs.spans import RECORDER_ENV, read_flight, summarize_flight
 from mpi_knn_tpu.resilience.heartbeat import HEARTBEAT_ENV, read_beat
 
 _GRACE_S = 2.0  # SIGTERM → SIGKILL escalation window
@@ -45,6 +46,11 @@ class WorkerResult:
     last_beat_label: str
     duration_s: float
     reason: str | None = None  # kill reason for "timeout", else None
+    # the banked flight record (obs.spans.summarize_flight): span/event
+    # counts plus the names of spans left OPEN at death — the incremental
+    # JSONL write means this survives a SIGKILLed child. None when the
+    # child recorded nothing.
+    flight: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -101,6 +107,7 @@ def run_supervised(
     stdout_bytes: int = 1 << 20,
     poll_s: float = 0.05,
     cwd: str | None = None,
+    flight_path: str | None = None,
 ) -> WorkerResult:
     """Run ``argv`` as a supervised worker subprocess.
 
@@ -112,12 +119,32 @@ def run_supervised(
     timeout yields ``status="timeout"`` with the reason recorded; a child
     that exits non-zero by itself is ``"crashed"``; rc 0 is ``"ok"``.
     ``None`` disables the corresponding bound.
+
+    The child also gets ``TKNN_FLIGHT_RECORD`` pointing at a span flight
+    file, so anything it traces (serve batches, bench phases, beats)
+    survives its death; the record is read back and banked on
+    ``WorkerResult.flight``. Pass ``flight_path`` to keep the raw JSONL
+    on disk (a caller-owned path is never deleted); the default temp
+    file is summarized and removed.
     """
     child_env = dict(os.environ if env is None else env)
     fd, beat_path = tempfile.mkstemp(prefix="tknn-beat-")
     os.close(fd)
     os.unlink(beat_path)  # the worker's first beat creates it
     child_env[HEARTBEAT_ENV] = beat_path
+    keep_flight = flight_path is not None
+    if flight_path is None:
+        fd, flight_path = tempfile.mkstemp(prefix="tknn-flight-")
+        os.close(fd)
+    # start every supervision from an empty record (a caller-provided
+    # path may hold a previous run's story — stale spans banked as this
+    # child's would misdiagnose the kill)
+    for p in (flight_path, flight_path + ".1"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    child_env[RECORDER_ENV] = flight_path
     out_f = tempfile.NamedTemporaryFile(
         prefix="tknn-worker-out-", delete=False
     )
@@ -194,9 +221,13 @@ def run_supervised(
             last_beat_label=last_label,
             duration_s=duration,
             reason=reason,
+            flight=summarize_flight(read_flight(flight_path)),
         )
     finally:
-        for p in (beat_path, out_f.name, err_f.name):
+        doomed = [beat_path, out_f.name, err_f.name]
+        if not keep_flight:
+            doomed += [flight_path, flight_path + ".1"]
+        for p in doomed:
             try:
                 os.unlink(p)
             except OSError:

@@ -24,6 +24,10 @@ Examples::
     mpi-knn query --data sift:100000 --synthetic 10000 \
         --batch-deadline-ms 50 --retries 2    # resilient serving: deadline,
         # transient-retry, NaN sentinel, degradation ladder (see --help)
+    mpi-knn query --data sift:100000 --synthetic 10000 \
+        --flight-record flight.jsonl --metrics-out metrics.json \
+        --profile-batches 8    # observability (mpi_knn_tpu.obs): span
+        # flight record, metrics snapshot, device-time split in --report
 """
 
 from __future__ import annotations
@@ -140,8 +144,29 @@ def build_parser() -> argparse.ArgumentParser:
                    "top-k (on by default with a resilience policy; trips "
                    "loudly with batch provenance)")
 
-    o = p.add_argument_group("output")
+    o = p.add_argument_group("output / observability (mpi_knn_tpu.obs)")
     o.add_argument("--report", default=None, help="write JSON report here")
+    o.add_argument("--flight-record", default=None, metavar="JSONL",
+                   help="record structured trace spans (index build, "
+                   "per-bucket compiles, per-batch dispatch→retire, "
+                   "retry/degradation events) to this append-only JSONL "
+                   "ring file, written incrementally so the record "
+                   "survives a killed process; inspect/validate/export "
+                   "with `mpi-knn metrics --flight`")
+    o.add_argument("--metrics-out", default=None, metavar="JSON",
+                   help="write the process metrics-registry snapshot "
+                   "(batch latency histogram, compile counters, "
+                   "resilience counters) at exit; render as Prometheus "
+                   "text with `mpi-knn metrics`")
+    o.add_argument("--profile-batches", type=int, default=None, metavar="N",
+                   help="after the stream, profile N extra steady-state "
+                   "batches under jax.profiler and embed the per-category "
+                   "device busy split (matmul/sort-topk/collective/copy/"
+                   "other + overlap fraction) in the report next to "
+                   "p50/p99")
+    o.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="with --profile-batches: keep the raw trace here "
+                   "(default: a temp dir)")
     o.add_argument("--platform", choices=["auto", "cpu", "tpu"],
                    default="auto")
     o.add_argument("-q", "--quiet", action="store_true")
@@ -238,6 +263,24 @@ def main(argv=None) -> int:
         # the loud exit-2 usage-error convention
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.profile_dir is not None and args.profile_batches is None:
+        # the inert-knob refusal convention: a kept trace dir without a
+        # profiling pass would silently record nothing
+        print("error: --profile-dir without --profile-batches: no "
+              "profiling pass runs, so the knob would be silently inert",
+              file=sys.stderr)
+        return 2
+    if args.profile_batches is not None and args.profile_batches < 1:
+        print("error: --profile-batches must be >= 1", file=sys.stderr)
+        return 2
+
+    if args.flight_record:
+        # install before any index/serve work so the index-build span and
+        # the warm-up compiles land in the record; fresh=True — a new run
+        # must not append to a previous run's story
+        from mpi_knn_tpu.obs.spans import FlightRecorder, set_recorder
+
+        set_recorder(FlightRecorder(args.flight_record, fresh=True))
 
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
@@ -440,6 +483,22 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         summary["probe_fraction"] = round(
             cfg.nprobe / index.partitions, 4
         )
+    if args.profile_batches:
+        # batches replay the stream's shape (--batch rows,
+        # corpus-distributed synthetic noise); session.profile compiles
+        # any bucket they still need BEFORE opening the trace (a short
+        # --queries file may never have served a full --batch), so the
+        # trace measures serving, not compilation.
+        rng = np.random.default_rng(2)
+        lo, hi = float(np.min(X)), float(np.max(X))
+        prof_batches = [
+            rng.uniform(lo, hi, size=(args.batch, X.shape[1]))
+            .astype(np.float32)
+            for _ in range(args.profile_batches)
+        ]
+        summary["device_time"] = session.profile(
+            prof_batches, trace_dir=args.profile_dir
+        )
     if session.policy is not None:
         # the degradation story, summarized where the round is read: how
         # often the deadline broke, what the ladder shed, where serving
@@ -465,6 +524,29 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
             f"{summary['executables_compiled']} executable(s) compiled, "
             f"index build {summary['index_build_s']}s)"
         )
+    if not args.quiet and "device_time" in summary:
+        dt = summary["device_time"]
+        if "busy_ms" in dt:
+            split = ", ".join(
+                f"{k} {v}ms" for k, v in sorted(dt["busy_ms"].items())
+            )
+            print(
+                f"[device-time] plane={dt['plane']} "
+                f"busy={dt['busy_total_ms']}ms ({split}) "
+                f"overlap-fraction={dt['overlap_fraction']}"
+            )
+        else:
+            print(f"[device-time] {dt.get('error', 'no attribution')}")
+    if args.metrics_out:
+        from mpi_knn_tpu.obs.metrics import get_registry
+
+        with open(args.metrics_out, "w") as f:
+            json.dump(get_registry().snapshot(), f, indent=1)
+            f.write("\n")
+        if not args.quiet:
+            print(f"metrics snapshot written to {args.metrics_out}")
+    if args.flight_record and not args.quiet:
+        print(f"flight record written to {args.flight_record}")
     if args.report:
         with open(args.report, "w") as f:
             json.dump(summary, f, indent=1)

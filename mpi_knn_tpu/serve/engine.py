@@ -56,9 +56,12 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.obs import metrics as obs_metrics
+from mpi_knn_tpu.obs import spans as obs_spans
 from mpi_knn_tpu.ops.topk import init_topk, init_topk_tiles, merge_topk
 from mpi_knn_tpu.parallel.partition import pad_rows_any, pad_to_multiple
 from mpi_knn_tpu.resilience.faults import fault_point, poison_topk
+from mpi_knn_tpu.resilience.heartbeat import maybe_beat
 from mpi_knn_tpu.resilience.ladder import (
     FULL_RUNG,
     PoisonedResultError,
@@ -351,30 +354,49 @@ def get_executable(
     key = (bucket, _fingerprint_cfg(cfg))
     exec_ = index._cache.get(key)
     if exec_ is None:
-        lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
-        qsh = None
-        if index.backend in ("ring", "ring-overlap"):
-            from mpi_knn_tpu.backends.ring import _query_spec
-
-            q_axis = index.ring_meta[0]
-            qsh = NamedSharding(
-                index.mesh, _query_spec(q_axis, index.ring_meta[1])
-            )
-        qids = jnp.full((q_pad,), -1, jnp.int32)
-        make_carry = None
-        if qsh is not None:
-            qids = jax.device_put(qids, qsh)
-            make_carry = jax.jit(
-                functools.partial(
-                    init_topk, q_pad, cfg.k, dtype=_acc_dtype(cfg)
-                ),
-                out_shardings=(qsh, qsh),
-            )
-        exec_ = _BucketExec(
-            lowered.compile(), bucket, q_pad, q_tile, cfg, index.backend,
-            q_sharding=qsh, qids=qids, make_carry=make_carry,
+        # the central compile capture must be live BEFORE the compile it
+        # is supposed to count (idempotent; jax is already imported here)
+        obs_metrics.install_jax_compile_listener()
+        sid = obs_spans.begin_span(
+            "compile", cat="compile", bucket=bucket, backend=index.backend,
+            policy=cfg.precision_policy,
         )
+        try:
+            lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
+            qsh = None
+            if index.backend in ("ring", "ring-overlap"):
+                from mpi_knn_tpu.backends.ring import _query_spec
+
+                q_axis = index.ring_meta[0]
+                qsh = NamedSharding(
+                    index.mesh, _query_spec(q_axis, index.ring_meta[1])
+                )
+            qids = jnp.full((q_pad,), -1, jnp.int32)
+            make_carry = None
+            if qsh is not None:
+                qids = jax.device_put(qids, qsh)
+                make_carry = jax.jit(
+                    functools.partial(
+                        init_topk, q_pad, cfg.k, dtype=_acc_dtype(cfg)
+                    ),
+                    out_shardings=(qsh, qsh),
+                )
+            exec_ = _BucketExec(
+                lowered.compile(), bucket, q_pad, q_tile, cfg, index.backend,
+                q_sharding=qsh, qids=qids, make_carry=make_carry,
+            )
+        except Exception as e:
+            # a raised lowering/compile failure is survivable by the
+            # caller — close the span with the error; an OPEN compile
+            # span must stay what the contract says: a kill diagnosis
+            obs_spans.end_span(sid, error=type(e).__name__)
+            raise
         index._cache[key] = exec_
+        obs_spans.end_span(sid)
+        obs_metrics.get_registry().counter(
+            "serve_executables_compiled_total",
+            help="(bucket, config) cells compiled by the serve cache",
+        ).inc()
     return exec_
 
 
@@ -583,6 +605,10 @@ class ServeSession:
         self.cfg = index.compatible_cfg(
             (config or index.cfg).replace(**overrides)
         )
+        # observability: every session feeds the shared registry (the
+        # compile capture must be live before warm()'s first compile)
+        obs_metrics.install_jax_compile_listener()
+        self._metrics = obs_metrics.get_registry()
         self.policy = resilience
         if resilience is not None:
             self.ladder = build_ladder(index, self.cfg, resilience)
@@ -612,11 +638,13 @@ class ServeSession:
         (Rungs whose program coincides with an already-compiled cell —
         a halved bucket that pads a given size to the same row count —
         hit the cache and cost nothing.)"""
-        for n in sizes:
-            for _, cfg in self.ladder:
-                get_executable(
-                    self.index, cfg, bucket_rows(n, cfg.query_bucket)
-                )
+        with obs_spans.span("warm", cat="serve", sizes=list(sizes),
+                            rungs=len(self.ladder)):
+            for n in sizes:
+                for _, cfg in self.ladder:
+                    get_executable(
+                        self.index, cfg, bucket_rows(n, cfg.query_bucket)
+                    )
 
     def reset_stats(self) -> None:
         """Start a fresh measurement window (in-flight batches keep their
@@ -638,6 +666,14 @@ class ServeSession:
         bad_inf = bool(d.size) and bool(np.isinf(d).all(axis=1).any())
         if bad_nan or bad_inf:
             kind = "NaN" if bad_nan else "all-inf row"
+            obs_spans.event(
+                "poisoned-result", cat="serve", seq=res.seq,
+                kind=kind, bucket=res.bucket,
+            )
+            self._metrics.counter(
+                "serve_poisoned_results_total",
+                help="batches whose top-k tripped the NaN/all-inf sentinel",
+            ).inc()
             raise PoisonedResultError(
                 f"poisoned top-k ({kind}) in served batch seq={res.seq} "
                 f"bucket={res.bucket} rows={res.rows} "
@@ -667,6 +703,10 @@ class ServeSession:
         res.deadline_breached = True
         self.deadline_breaches += 1
         self._consecutive_breaches += 1
+        self._metrics.counter(
+            "serve_deadline_breaches_total",
+            help="batches whose dispatch→sync latency overran the deadline",
+        ).inc()
         if (
             self._consecutive_breaches >= pol.degrade_after
             and self._rung < len(self.ladder) - 1
@@ -678,16 +718,57 @@ class ServeSession:
                 "rung": self.ladder[self._rung][0],
                 "breaches": self.deadline_breaches,
             })
+            obs_spans.event(
+                "degrade", cat="serve", after_batch=res.seq,
+                rung=self.ladder[self._rung][0],
+                breaches=self.deadline_breaches,
+            )
+            self._metrics.counter(
+                "serve_degradations_total",
+                help="ladder rungs shed on sustained deadline breach",
+            ).inc()
+            self._metrics.gauge(
+                "serve_ladder_rung",
+                help="current degradation-ladder rung index (0 = full)",
+            ).set(self._rung)
 
     def _retire(self) -> BatchResult:
-        res, t0 = self._inflight.popleft()
+        res, t0, sid = self._inflight.popleft()
         device_sync(res.dists_padded, res.ids_padded)
         res.latency_s = time.perf_counter() - t0
         self.latencies.append(res.latency_s)
         self.queries_served += res.rows
         self._note_latency(res)
         if self.policy is not None and self.policy.nan_sentinel:
-            self._check_sentinel(res)
+            try:
+                self._check_sentinel(res)
+            except PoisonedResultError:
+                # the process survives a caught sentinel trip — close the
+                # span with the error so an OPEN span stays what the
+                # contract says it is: a kill diagnosis, never a raise
+                obs_spans.end_span(
+                    sid, latency_s=res.latency_s, retries=res.retries,
+                    error="poisoned-result",
+                )
+                raise
+        # the dispatch→retire span closes with the same honest latency
+        # the session reports; a beat per retire lets a supervisor see
+        # serving progress (a wedged dispatch stops both immediately)
+        obs_spans.end_span(
+            sid, latency_s=res.latency_s, retries=res.retries,
+            deadline_breached=res.deadline_breached,
+        )
+        maybe_beat(f"serve-batch-{res.seq}")
+        self._metrics.counter(
+            "serve_batches_total", help="batches retired"
+        ).inc()
+        self._metrics.counter(
+            "serve_queries_total", help="query rows served (padding excluded)"
+        ).inc(res.rows)
+        self._metrics.histogram(
+            "serve_batch_latency_seconds",
+            help="per-batch dispatch→device_sync latency",
+        ).observe(res.latency_s)
         return res
 
     def _dispatch(self, queries, cfg: KNNConfig):
@@ -704,21 +785,44 @@ class ServeSession:
     def submit(self, queries) -> list[BatchResult]:
         t0 = time.perf_counter()
         label, cfg = self.ladder[self._rung]
+        # the batch span opens BEFORE the dispatch attempt: a hang inside
+        # the dispatch leaves an OPEN "batch" record in the flight file —
+        # the kill diagnosis a supervisor banks (ISSUE 7)
+        sid = obs_spans.begin_span(
+            "batch", cat="serve", seq=self._seq,
+            rows=int(queries.shape[0]), rung=label,
+        )
         pol = self.policy
-        if pol is not None and pol.max_retries > 0:
-            out = retry_with_backoff(
-                lambda: self._dispatch(queries, cfg),
-                retries=pol.max_retries,
-                base_s=pol.backoff_base_s,
-                max_s=pol.backoff_max_s,
-                retryable=pol.retryable,
-            )
-            bucket, rows, d, i = out.value
-            retries, backoffs = out.attempts - 1, out.backoffs
-            self.retries_total += retries
-        else:
-            bucket, rows, d, i = self._dispatch(queries, cfg)
-            retries, backoffs = 0, ()
+        try:
+            if pol is not None and pol.max_retries > 0:
+                out = retry_with_backoff(
+                    lambda: self._dispatch(queries, cfg),
+                    retries=pol.max_retries,
+                    base_s=pol.backoff_base_s,
+                    max_s=pol.backoff_max_s,
+                    retryable=pol.retryable,
+                )
+                bucket, rows, d, i = out.value
+                retries, backoffs = out.attempts - 1, out.backoffs
+                self.retries_total += retries
+                if retries:
+                    obs_spans.event(
+                        "retry", cat="retry", seq=self._seq,
+                        retries=retries, backoffs=list(backoffs),
+                    )
+                    self._metrics.counter(
+                        "serve_retries_total",
+                        help="transient dispatch failures retried",
+                    ).inc(retries)
+            else:
+                bucket, rows, d, i = self._dispatch(queries, cfg)
+                retries, backoffs = 0, ()
+        except Exception as e:
+            # a RAISED dispatch failure (retries exhausted, non-retryable
+            # fault) is survivable by the caller — close the span with
+            # the error; only a hang/kill leaves it open
+            obs_spans.end_span(sid, error=type(e).__name__)
+            raise
         res = BatchResult(
             d, i, rows, bucket,
             seq=self._seq,  # 0-indexed, matching the CLI's printed lines
@@ -727,7 +831,7 @@ class ServeSession:
             backoffs=backoffs,
         )
         self._seq += 1
-        self._inflight.append((res, t0))
+        self._inflight.append((res, t0, sid))
         done = []
         # bound the dispatch-ahead window: at depth d, batch t+d-1 may be
         # prepared/dispatched while batch t is still in flight; depth 1
@@ -747,3 +851,36 @@ class ServeSession:
         for q in batches:
             yield from self.submit(q)
         yield from self.drain()
+
+    def profile(self, batches, trace_dir: str | None = None) -> dict:
+        """Opt-in device-time attribution: serve ``batches`` under
+        ``jax.profiler.trace`` and return the per-category device busy
+        split (``mpi_knn_tpu.obs.attribution``) — matmul / sort-topk /
+        collective / copy / other plus the collective-under-compute
+        overlap fraction. Steady state is enforced here: every bucket
+        the profile batches need is compiled BEFORE the trace opens —
+        a batch size the stream never served would otherwise cold-compile
+        inside the trace, the compile events would categorize as "other",
+        and the split would measure compilation while claiming serving."""
+        import tempfile
+
+        from mpi_knn_tpu.obs.attribution import attribute_trace
+
+        batches = list(batches)
+        _, cfg = self.ladder[self._rung]
+        for rows in sorted({int(q.shape[0]) for q in batches}):
+            get_executable(
+                self.index, cfg, bucket_rows(rows, cfg.query_bucket)
+            )
+        tdir = trace_dir or tempfile.mkdtemp(prefix="tknn-profile-")
+        n = 0
+        with obs_spans.span("profile", cat="profile", trace_dir=tdir):
+            with jax.profiler.trace(tdir):
+                for q in batches:
+                    self.submit(q)
+                    n += 1
+                self.drain()
+        out = attribute_trace(tdir)
+        out["batches_profiled"] = n
+        out["trace_dir"] = tdir
+        return out

@@ -137,12 +137,19 @@ def build_index(
       mesh: optional ring mesh for the distributed backends.
     """
     from mpi_knn_tpu.api import resolve_backend
+    from mpi_knn_tpu.obs.spans import span as _flight_span
 
     cfg = (config or KNNConfig()).replace(**overrides)
     if not isinstance(corpus, jax.Array):
         corpus = np.asarray(corpus)
     m, dim = corpus.shape
     backend = resolve_backend(cfg, mesh)
+    with _flight_span("index-build", cat="index", backend=backend,
+                      m=int(m), dim=int(dim)):
+        return _build_index_resident(corpus, cfg, mesh, backend, m, dim)
+
+
+def _build_index_resident(corpus, cfg, mesh, backend, m, dim) -> CorpusIndex:
 
     mu = None
     if cfg.center and cfg.metric == "l2":

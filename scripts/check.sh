@@ -88,6 +88,30 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly || fail=1
 
+note "observability artifacts (ISSUE 7 gate: mpi-knn metrics)"
+# run a real (tiny) serve session with the flight recorder and metrics
+# snapshot on, then prove the artifacts are machine-readable: every span
+# record validates against the schema (no NaN/negative durations, ends
+# match opens, parents exist — `--validate` exits 1 on any problem) and
+# the Prometheus exposition round-trips through the strict parser
+# (`--check`). This is the same obs stack test_obs.py exercises, but
+# driven through the production CLIs end to end, so a serialization
+# regression fails here by name
+OBS_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP"' EXIT
+if timeout -k 10 180 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu query \
+        --data synthetic:2048x32c4 --synthetic 512 --batch 128 \
+        --bucket 128 --k 10 --backend serial \
+        --flight-record "$OBS_TMP/flight.jsonl" \
+        --metrics-out "$OBS_TMP/metrics.json" >/dev/null; then
+    python -m mpi_knn_tpu metrics --flight "$OBS_TMP/flight.jsonl" \
+        --validate || fail=1
+    python -m mpi_knn_tpu metrics "$OBS_TMP/metrics.json" --check || fail=1
+else
+    echo "obs gate: serve session failed"
+    fail=1
+fi
+
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -42,8 +42,17 @@ def main() -> int:
     bench = [r for r in timed if not r.get("failed")]
     failed = [r for r in timed if r.get("failed")]
     status = [r for r in r3 if "status" in r or "result" in r] + [
+        # failed lines carry value: null + an explicit time_until_kill_s
+        # (pre-ISSUE-7 rounds stamped the kill time into 'value'; read
+        # both so old round files still fold)
         {"step": r.get("step", r.get("metric", "?")),
-         "status": f"WATCHDOG-FAILED at {r['value']} s"}
+         "status": "WATCHDOG-FAILED at "
+                   f"{r.get('time_until_kill_s', r.get('value'))} s"
+                   + (" (open spans: "
+                      + ", ".join(s["name"]
+                                  for s in r["flight"]["open_spans"])
+                      + ")"
+                      if r.get("flight", {}).get("open_spans") else "")}
         for r in failed
     ]
     other = [r for r in r3 if r not in timed and r not in status]

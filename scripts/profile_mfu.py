@@ -15,7 +15,13 @@ variant it measures
   matmul precision noted (HIGHEST f32 ≈ 6 bf16 passes, HIGH ≈ 3, DEFAULT=1)
   so "delivered" MXU work can be read off the same row;
 - optionally a ``jax.profiler.trace`` of one rep per variant
-  (``--profile-dir``), inspectable with XProf/TensorBoard.
+  (``--profile-dir``), inspectable with XProf/TensorBoard — and
+  attributed in-row through the library (``mpi_knn_tpu.obs.attribution``,
+  ISSUE 7): each profiled row carries the per-category device busy split
+  (matmul / sort-topk / collective / copy / other + overlap fraction),
+  the same numbers `mpi-knn query --profile-batches` embeds in its
+  report, so this script is a thin CLI over the shared parser instead of
+  leaving raw trace dirs to a second tool.
 
 Usage:
     python scripts/profile_mfu.py [--m 60000] [--d 784] [--k 10]
@@ -254,6 +260,12 @@ def main(argv=None) -> int:
                 run()
                 sync()
             row["trace_dir"] = tdir
+            # per-category device-time split off the captured trace, via
+            # the shared library parser (a failed parse lands as an
+            # {"error": ...} block, never a zero-filled split)
+            from mpi_knn_tpu.obs.attribution import attribute_trace
+
+            row["device_time"] = attribute_trace(tdir)
         emit(row)
 
     summary = {

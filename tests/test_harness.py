@@ -349,6 +349,35 @@ def test_bench_watchdog_cpu_fallback():
     assert "failed" not in head
 
 
+def test_bench_failed_line_shape_is_not_a_measurement():
+    """ISSUE 7 regression (BENCH_r05): a watchdog kill must NEVER bank as
+    a measurement. BENCH_r05 stamped `value: 480.0, vs_baseline: 0.0` on
+    a timeout — a kill posing as a zero-regression data point. Failed
+    lines carry `value: null`, the kill time in an explicit
+    `time_until_kill_s` field, and no `vs_baseline` key at all (the
+    subprocess-level version of this pin lives in test_resilience.py)."""
+    import bench
+
+    doc = bench._failed_line(
+        "mnist60k_allknn_k10_seconds", "wedged", "timeout",
+        time_until_kill_s=12.3,
+        flight={"records": 4, "spans_complete": 1, "events": 2,
+                "open_spans": [{"name": "warm", "cat": "bench",
+                                "attrs": {}}], "last": []},
+    )
+    assert doc["value"] is None
+    assert "vs_baseline" not in doc
+    assert doc["time_until_kill_s"] == 12.3
+    assert doc["failed"] is True and doc["status"] == "timeout"
+    assert doc["series"] == "wedged"
+    assert doc["flight"]["open_spans"][0]["name"] == "warm"
+    # a line that never ran (preflight refusal) has no flight record and
+    # 0 s until the kill — still value: null, still no vs_baseline
+    pre = bench._failed_line("m", "s0", "preflight", time_until_kill_s=0.0)
+    assert pre["value"] is None and "vs_baseline" not in pre
+    assert "flight" not in pre
+
+
 def test_ring_ab_script():
     """scripts/ring_ab.py runs the full 2×2 A/B matrix (uni/bidir ×
     blocking/overlap) and reports per-cell timings + four-way agreement."""
@@ -436,6 +465,10 @@ def test_fold_round_renders_round_rows(tmp_path, capsys, monkeypatch):
         ' "unit": "s", "vs_baseline": 1.16, "recall": 1.0}\n'
         '{"step": "bench-ct2048", "metric": "mnist60k_allknn_s",'
         ' "value": 240, "unit": "s", "vs_baseline": 0.0, "failed": true}\n'
+        '{"metric": "mnist60k_allknn_k5_s", "value": null, "unit": "s",'
+        ' "failed": true, "series": "wedged", "status": "timeout",'
+        ' "time_until_kill_s": 6.1, "flight": {"records": 3,'
+        ' "open_spans": [{"name": "warm", "cat": "bench", "attrs": {}}]}}\n'
         '{"step": "svd1", "status": "ABORT-device-dead", "ts": "t"}\n'
     )
     (tmp_path / "mfu_rows.jsonl").write_text(
@@ -463,9 +496,13 @@ def test_fold_round_renders_round_rows(tmp_path, capsys, monkeypatch):
     assert fold_round.main() == 0
     out = capsys.readouterr().out
     assert "| confirm | mnist60k_allknn_s | 0.97 s | 1.16 |" in out
-    # the watchdog sentinel is a status line, never a measurement row
+    # the watchdog sentinel is a status line, never a measurement row —
+    # for both the legacy shape (kill time in 'value', pre-ISSUE-7) and
+    # the current one (value: null + time_until_kill_s + banked flight)
     assert "| bench-ct2048 |" not in out
     assert "WATCHDOG-FAILED at 240 s" in out
+    assert "| mnist60k_allknn_k5_s |" not in out
+    assert "WATCHDOG-FAILED at 6.1 s (open spans: warm)" in out
     assert "ABORT-device-dead" in out
     # last row per variant wins; the torn stream row is skipped entirely
     assert "| twolevel | 1.0 s | 2.90 %" in out

@@ -61,21 +61,13 @@ def _clustered(rng, m=2048, d=48, centers=24, spread=0.25):
 
 @pytest.fixture
 def compile_counter():
-    """XLA backend-compile counter via jax.monitoring (the test_serve.py
-    machine check that a cache hit really compiled nothing)."""
-    from jax import monitoring
+    """XLA backend-compile counter (the test_serve.py machine check that
+    a cache hit really compiled nothing), on the shared obs-registry
+    scope instead of a third hand-rolled jax.monitoring listener."""
+    from mpi_knn_tpu.obs.metrics import watch_compiles
 
-    counts = []
-
-    def listener(name, secs, **kw):
-        if name == "/jax/core/compile/backend_compile_duration":
-            counts.append(name)
-
-    monitoring.register_event_duration_secs_listener(listener)
-    try:
+    with watch_compiles() as counts:
         yield counts
-    finally:
-        monitoring.clear_event_listeners()
 
 
 # ---------------------------------------------------------------------------

@@ -466,7 +466,7 @@ def test_warm_precompiles_every_ladder_rung(rng):
     """The first batch after a degradation lands at the moment of
     overload — warm() must pre-compile every rung's cell so a cold
     compile cannot itself breach the deadline and cascade the ladder."""
-    from jax import monitoring
+    from mpi_knn_tpu.obs.metrics import watch_compiles
 
     X = rng.standard_normal((128, 16)).astype(np.float32)
     idx = build_index(X, _serve_cfg())
@@ -476,19 +476,10 @@ def test_warm_precompiles_every_ladder_rung(rng):
     sess = ServeSession(idx, resilience=pol)
     sess.warm([16])
 
-    compiles = []
-
-    def listener(name, secs, **kw):
-        if name == "/jax/core/compile/backend_compile_duration":
-            compiles.append(name)
-
-    monitoring.register_event_duration_secs_listener(listener)
-    try:
+    with watch_compiles() as compiles:
         with install_faults({"serve-batch": ("slow", 0.02)}):
             for _ in range(len(sess.ladder) + 1):
                 sess.submit(np.ones((16, 16), dtype=np.float32))
-    finally:
-        monitoring.clear_event_listeners()
     assert sess.rung == sess.ladder[-1][0]  # the ladder WAS walked
     assert compiles == []  # ...with zero compiles after warm()
 
@@ -639,12 +630,19 @@ def test_bench_partial_round_banks_siblings_of_a_wedged_series():
     assert good["value"] > 0 and "failed" not in good
 
     # the wedged series banks a structured failed line under its own
-    # series name — never a bare rc-2 watchdog error
+    # series name — never a bare rc-2 watchdog error. ISSUE 7 shape: a
+    # kill is NOT a measurement — value is null, the kill time lives in
+    # the explicit time_until_kill_s field, and no vs_baseline can ever
+    # be read off the line (BENCH_r05 banked value:480/vs_baseline:0.0)
     assert wedged["failed"] is True
     assert wedged["metric"] == "mnist0k_allknn_k5_seconds"
     assert wedged["series"] == "wedged" and wedged["status"] == "timeout"
-    assert 0 < wedged["value"] < 60  # killed by starvation, not wall
-    assert wedged["vs_baseline"] == 0.0
+    assert wedged["value"] is None
+    assert "vs_baseline" not in wedged
+    assert 0 < wedged["time_until_kill_s"] < 60  # starvation, not wall
+    # the child's span flight record survives the SIGKILL and is banked
+    # alongside (the 'start' beat fired before the injected hang)
+    assert wedged["flight"]["records"] >= 1
 
     # supervisor notes: the kill reason and the usage-error refusal are
     # on stderr for the operator, non-JSON (fold_round reads the last

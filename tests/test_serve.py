@@ -36,22 +36,15 @@ def _cfg(backend, **kw):
 
 @pytest.fixture
 def compile_counter():
-    """Count XLA backend compiles via jax.monitoring — the machine check
-    that a 'cache hit' really compiled nothing, independent of the
-    engine's own cache bookkeeping."""
-    from jax import monitoring
+    """Count XLA backend compiles — the machine check that a 'cache hit'
+    really compiled nothing, independent of the engine's own cache
+    bookkeeping. The shared obs-registry scope (the same events also
+    feed `jax_compiles_total` in the process-wide registry) replaced the
+    hand-rolled jax.monitoring listener this file used to carry."""
+    from mpi_knn_tpu.obs.metrics import watch_compiles
 
-    counts = []
-
-    def listener(name, secs, **kw):
-        if name == "/jax/core/compile/backend_compile_duration":
-            counts.append(name)
-
-    monitoring.register_event_duration_secs_listener(listener)
-    try:
+    with watch_compiles() as counts:
         yield counts
-    finally:
-        monitoring.clear_event_listeners()
 
 
 # ---------------------------------------------------------------------------
