@@ -62,7 +62,11 @@ BENCH_PALLAS_VARIANT (tiles|sweep), BENCH_IVF_PARTITIONS /
 BENCH_IVF_NPROBE (clustered-index path: k-means partitions trained
 outside the timed region, per-query probed scan timed; the series name
 carries the knobs and the gate is the configured recall_target — the
-clustered rung's own acceptance bar), BENCH_WATCHDOG_S (per-series wall
+clustered rung's own acceptance bar), BENCH_IVF_SHARDS (the SHARDED
+clustered path, mpi_knn_tpu.ivf.sharded: the bucket store distributed
+over that many ring-mesh devices with the routed all-to-all candidate
+exchange; requires BENCH_IVF_PARTITIONS, series name carries the shard
+count), BENCH_WATCHDOG_S (per-series wall
 bound, 0 disables), BENCH_BEAT_TIMEOUT_S (per-series beat-starvation
 bound, 0 disables), BENCH_SERIES / BENCH_DOCTOR (supervisor, above),
 BENCH_PLATFORM (forces jax_platforms via the config API — JAX_PLATFORMS
@@ -106,6 +110,11 @@ def metric_name(env=None) -> str:
         p = env["BENCH_IVF_PARTITIONS"]
         n = env.get("BENCH_IVF_NPROBE", "auto")
         ivf = f"_ivf{p}p{n}"
+        if env.get("BENCH_IVF_SHARDS"):
+            # a sharded run measures a different program (routed exchange
+            # over the mesh) and must never masquerade as the
+            # single-device clustered series
+            ivf += f"s{env['BENCH_IVF_SHARDS']}"
     return f"mnist{m // 1000}k_allknn_k{k}{ivf}_seconds"
 
 
@@ -142,7 +151,17 @@ def main() -> int:
         # the only reliable way to keep a CPU smoke run off the tunnel
         from mpi_knn_tpu.utils.platform import force_platform
 
-        force_platform(os.environ["BENCH_PLATFORM"])
+        # a sharded clustered series needs a real multi-device mesh: on
+        # the forced-CPU platform that means virtual host devices, sized
+        # to the shard count BEFORE the backend comes up
+        _shards = os.environ.get("BENCH_IVF_SHARDS")
+        force_platform(
+            os.environ["BENCH_PLATFORM"],
+            n_devices=(int(_shards)
+                       if _shards and _shards.isdigit()
+                       and os.environ["BENCH_PLATFORM"] == "cpu"
+                       else None),
+        )
     maybe_beat("platform")
 
     import jax
@@ -207,6 +226,54 @@ def main() -> int:
     # (DESIGN.md ladder rung 4); vs_baseline still zeroes on a miss.
     ivf_partitions = os.environ.get("BENCH_IVF_PARTITIONS")
     ivf_nprobe = os.environ.get("BENCH_IVF_NPROBE")
+    ivf_shards = os.environ.get("BENCH_IVF_SHARDS")
+    if ivf_shards and not ivf_partitions:
+        print(
+            json.dumps({
+                "error": "BENCH_IVF_SHARDS without BENCH_IVF_PARTITIONS: "
+                "sharding distributes a clustered index's partition "
+                "buckets over the mesh — a shard count without "
+                "partitions would be silently ignored"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_shards and not ivf_shards.isdigit():
+        # a typo'd knob must be a usage refusal (never banked, never
+        # fallback-triggering), not an uncaught crash the supervisor
+        # books as a failed series
+        print(
+            json.dumps({
+                "error": f"BENCH_IVF_SHARDS={ivf_shards!r} is not a "
+                "positive integer"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_shards and int(ivf_shards) > len(jax.devices()):
+        print(
+            json.dumps({
+                "error": f"BENCH_IVF_SHARDS={ivf_shards} exceeds the "
+                f"{len(jax.devices())} visible device(s): the sharded "
+                "clustered index places one bucket slice per device — "
+                "set BENCH_PLATFORM=cpu for virtual host devices, or "
+                "lower the shard count"
+            }),
+            file=sys.stderr,
+        )
+        return 2
+    if ivf_shards and os.environ.get("BENCH_RING_XFER"):
+        print(
+            json.dumps({
+                "error": "BENCH_RING_XFER conflicts with "
+                "BENCH_IVF_SHARDS: the candidate exchange moves bucket "
+                "rows at the at-rest dtype (BENCH_DTYPE=bfloat16 halves "
+                "exchange bytes) — there is no ring rotation to re-dtype, "
+                "so the knob would be silently ignored"
+            }),
+            file=sys.stderr,
+        )
+        return 2
     if ivf_nprobe and not ivf_partitions:
         print(
             json.dumps({
@@ -302,6 +369,7 @@ def main() -> int:
         zero_eps=0.0 if center else 64.0,
         partitions=int(ivf_partitions) if ivf_partitions else None,
         nprobe=int(ivf_nprobe) if ivf_nprobe else None,
+        ivf_shards=int(ivf_shards) if ivf_shards else None,
         # bench default HIGH (3-pass bf16): measured recall 1.0 on the
         # integer-pixel corpus with ~4% median win over HIGHEST (r3 A/B,
         # BASELINE.md). The LIBRARY default stays HIGHEST — the bench knows
@@ -327,23 +395,46 @@ def main() -> int:
         # device ONCE, so the timed region is probe compute + sync only
         # (the dense series' timer placement — a per-rep host centering
         # pass would make the two series incomparable)
+        # build_ivf_index dispatches on cfg.ivf_shards: the sharded form
+        # trains the same single-device k-means then distributes the
+        # bucket store over the ring mesh (ivf/sharded.py) — either way
+        # the build is the amortized half, outside the timed region
         index = build_ivf_index(X, cfg)
         maybe_beat("index-build")
         rcfg = index.compatible_cfg(index.cfg)
         qids = np.arange(m, dtype=np.int32)
-        q_tiles, qid_tiles, q_pad, _ = prepare_query_tiles(
-            index, X, qids, rcfg
-        )
+        if ivf_shards:
+            from mpi_knn_tpu.ivf.sharded import (
+                prepare_sharded_tiles,
+                run_sharded_tiles,
+            )
+
+            q_tiles, qid_tiles, q_pad, _, route_cap = prepare_sharded_tiles(
+                index, X, qids, rcfg
+            )
+
+            def run_ivf():
+                d, i, _ = run_sharded_tiles(
+                    index, q_tiles, qid_tiles, rcfg, route_cap
+                )
+                return d, i
+        else:
+            q_tiles, qid_tiles, q_pad, _ = prepare_query_tiles(
+                index, X, qids, rcfg
+            )
+
+            def run_ivf():
+                return run_query_tiles(index, q_tiles, qid_tiles, rcfg)
         device_sync(q_tiles)
-        with flight_span("warm", cat="bench", backend="ivf"):
-            d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)  # warm
+        with flight_span("warm", cat="bench", backend=index.backend):
+            d, i = run_ivf()  # warm
             device_sync(d, i)
         maybe_beat("warm")
         times = []
         for r in range(reps):
             with flight_span("rep", cat="bench", rep=r):
                 t0 = time.perf_counter()
-                d, i = run_query_tiles(index, q_tiles, qid_tiles, rcfg)
+                d, i = run_ivf()
                 device_sync(d, i)
                 times.append(time.perf_counter() - t0)
             maybe_beat(f"rep{r}")
@@ -412,6 +503,7 @@ def main() -> int:
                 "precision_policy": cfg.precision_policy,
                 "partitions": cfg.partitions,
                 "nprobe": (index.nprobe if ivf_partitions else None),
+                "ivf_shards": cfg.ivf_shards,
                 "recall_gate": gate,
                 "merge_schedule": cfg.merge_schedule,
                 "tiles": [cfg.query_tile, cfg.corpus_tile],
@@ -556,8 +648,8 @@ def _cpu_fallback_line(primary_metric: str):
     # and the supervisor's own knobs
     for k in ("BENCH_RING_SCHEDULE", "BENCH_RING_XFER",
               "BENCH_PALLAS_VARIANT", "BENCH_IVF_PARTITIONS",
-              "BENCH_IVF_NPROBE", "BENCH_SERIES", "BENCH_DOCTOR",
-              "TKNN_FAULTS"):
+              "BENCH_IVF_NPROBE", "BENCH_IVF_SHARDS", "BENCH_SERIES",
+              "BENCH_DOCTOR", "TKNN_FAULTS"):
         env.pop(k, None)
     env.update(
         BENCH_PLATFORM="cpu",

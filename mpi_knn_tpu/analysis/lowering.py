@@ -33,10 +33,11 @@ STAGES = ("before_opt", "after_opt")
 LINT_DTYPES = ("float32", "bfloat16", "float64")
 LINT_POLICIES = ("exact", "mixed")
 # the dense (full-scan) backends sweep the whole metric × dtype product;
-# the clustered "ivf" cells are appended explicitly (l2/float32 only — the
-# IVF path's own contract) but share the CLI filter namespace
+# the clustered "ivf" / "ivf-sharded" cells are appended explicitly
+# (l2/float32 only — the IVF path's own contract) but share the CLI
+# filter namespace
 DENSE_LINT_BACKENDS = tuple(b for b in BACKENDS if b != "auto")
-LINT_BACKENDS = DENSE_LINT_BACKENDS + ("ivf",)
+LINT_BACKENDS = DENSE_LINT_BACKENDS + ("ivf", "ivf-sharded")
 
 # Small but structurally faithful: 8 query tiles, 8 corpus tiles, an 8-way
 # ring with one (q_tile × c_tile) block tile per device per round — every
@@ -133,6 +134,20 @@ def default_targets() -> list[LintTarget]:
         LintTarget("ivf", "l2", "float32", serve=True),
         LintTarget("ivf", "l2", "float32", "mixed", serve=True),
     ] + [
+        # the SHARDED clustered cells (ivf/sharded.py): the routed
+        # candidate exchange over a 4-shard CPU mesh — R2-strict's
+        # probed-bytes budget is enforced PER SHARD (the exchange buffers
+        # + rerank working set of one shard's resident tile, never the
+        # global corpus), R4 accounts the exchange all-to-alls (count,
+        # full-ring replica groups, payload bytes ≤ the declared per-tile
+        # budget), R6 re-certifies the probe discipline on the routed
+        # gathers, and the serve cells add R5's every-output-aliased
+        # donation contract (three outputs, three donated scratches)
+        LintTarget("ivf-sharded", "l2", "float32"),
+        LintTarget("ivf-sharded", "l2", "float32", "mixed"),
+        LintTarget("ivf-sharded", "l2", "float32", serve=True),
+        LintTarget("ivf-sharded", "l2", "float32", "mixed", serve=True),
+    ] + [
         # the degradation-ladder rung programs (resilience/ladder.py):
         # under sustained deadline breach ServeSession serves smaller-
         # nprobe / mixed / smaller-bucket cells of the SAME executable
@@ -147,6 +162,14 @@ def default_targets() -> list[LintTarget]:
         LintTarget("serial", "l2", "float32", serve=True, ladder="bucket"),
         LintTarget("ivf", "l2", "float32", serve=True, ladder="bucket"),
         LintTarget("ivf", "l2", "float32", serve=True, ladder="nprobe"),
+        # the sharded nprobe rung: the resilience ladder's first shed on
+        # a sharded session — at the safe route cap the exchange buffers
+        # scale with nprobe, so R2-strict's per-shard budget here is
+        # HALF the full rung's (re-derived from the rung cfg; a rung
+        # program materializing beyond its own smaller bound is a
+        # finding), with R5's donation contract intact on degraded cells
+        LintTarget("ivf-sharded", "l2", "float32", serve=True,
+                   ladder="nprobe"),
     ]
 
 
@@ -422,6 +445,117 @@ def _lower_ivf(target: LintTarget):
     return lowered, cfg, _ivf_meta(index, cfg, q_tile)
 
 
+# sharded-IVF lint shapes: the same trained 256-row/8-partition index,
+# distributed over a 4-shard CPU mesh at the SAFE route cap (None →
+# q_tile·nprobe — the default configuration users get; the exchange
+# buffers then scale with nprobe, which is what makes the ladder's
+# nprobe rung re-lint against a genuinely SMALLER per-shard budget)
+LINT_IVF_SHARDS = 4
+
+
+def _sharded_cfg(target: LintTarget) -> KNNConfig:
+    return _ivf_cfg(target).replace(ivf_shards=LINT_IVF_SHARDS)
+
+
+@functools.lru_cache(maxsize=None)
+def _ivf_sharded_lint_index(cfg: KNNConfig):
+    """The lint IVFIndex distributed over the 4-shard mesh — shared by
+    the one-shot, serve, and ladder sharded cells."""
+    from mpi_knn_tpu.ivf import shard_ivf_index
+
+    plain = _ivf_lint_index(cfg.replace(ivf_shards=None, ivf_route_cap=None))
+    return shard_ivf_index(plain, shards=cfg.ivf_shards)
+
+
+def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
+                      route_cap: int) -> dict:
+    from mpi_knn_tpu.ivf.sharded import (
+        exchange_bytes_per_tile,
+        exchange_elems,
+    )
+
+    v = cfg.nprobe * index.bucket_cap
+    return {
+        "q_tile": q_tile,
+        "c_tile": v,
+        "acc_bytes": 4,
+        "partitions": index.partitions,
+        "dim": index.dim,
+        "shards": index.shards,
+        "route_cap": route_cap,
+        # R4: the candidate exchange is exactly these four all-to-alls
+        # (request table + rows/ids/norms returns), full-ring groups,
+        # payload bytes inside this declared per-tile budget
+        "expected_alltoalls": 4,
+        "exchange_bytes_tile": exchange_bytes_per_tile(
+            index.shards, route_cap, index.bucket_cap, index.dim,
+            index.buckets.dtype.itemsize,
+        ),
+        # R2 STRICT, per shard: one resident tile's rerank working set or
+        # its exchange buffers, whichever is larger — NOT the shard's
+        # resident slice and never the global corpus
+        "budget_elems": max(
+            q_tile * v * index.dim,
+            exchange_elems(
+                index.shards, route_cap, index.bucket_cap, index.dim
+            ),
+        ),
+    }
+
+
+def _require_sharded_mesh() -> None:
+    if len(jax.devices()) < LINT_IVF_SHARDS:
+        raise UnsupportedTarget(
+            f"sharded-ivf targets need a {LINT_IVF_SHARDS}-device mesh "
+            "(force the CPU platform with virtual devices first, as the "
+            "lint CLI does)"
+        )
+
+
+def _lower_ivf_sharded(target: LintTarget):
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mpi_knn_tpu.ivf.sharded import (
+        N_STATS,
+        _ivf_sharded_jit,
+        sharded_query_shapes,
+    )
+
+    if target.metric != "l2" or target.dtype != "float32":
+        raise UnsupportedTarget(
+            "the clustered (IVF) path is l2/float32 by its own contract "
+            "(ivf/index.py rejects other combinations)"
+        )
+    _require_sharded_mesh()
+    cfg = _sharded_cfg(target)
+    index = _ivf_sharded_lint_index(cfg)
+    cfg = index.compatible_cfg(cfg)
+    q_tile, q_pad, route_cap = sharded_query_shapes(
+        cfg, cfg.nprobe, index.bucket_cap, index.dim, LINT_NQ, index.shards
+    )
+    qt = q_pad // q_tile
+    qsh = NamedSharding(index.mesh, PartitionSpec(index.axis))
+    sds = jax.ShapeDtypeStruct
+    lowered = _ivf_sharded_jit.lower(
+        sds((qt, q_tile, index.dim), jnp.float32, sharding=qsh),
+        sds((qt, q_tile), jnp.int32, sharding=qsh),
+        sds((qt, q_tile, cfg.k), jnp.float32, sharding=qsh),
+        sds((qt, q_tile, cfg.k), jnp.int32, sharding=qsh),
+        sds((N_STATS * index.shards,), jnp.int32, sharding=qsh),
+        index.centroids,
+        index.centroid_sqs,
+        index.buckets,
+        index.bucket_ids,
+        index.bucket_sqs,
+        cfg,
+        cfg.nprobe,
+        index.mesh,
+        index.axis,
+        index.shards,
+        route_cap,
+    )
+    return lowered, cfg, _ivf_sharded_meta(index, cfg, q_tile, route_cap)
+
+
 def _lower_serve(target: LintTarget):
     """Lower the serving engine's per-batch program for one cell through
     the PRODUCTION path: a real (small) CorpusIndex is built and
@@ -437,6 +571,42 @@ def _lower_serve(target: LintTarget):
     # also SHRINKS R2-strict's probed-bytes budget below — the rung must
     # fit its own smaller bound, not ride on the full rung's)
     bucket = LINT_NQ // 2 if target.ladder == "bucket" else LINT_NQ
+
+    if target.backend == "ivf-sharded":
+        # the sharded clustered serve cells lower through the production
+        # lower_bucket like every other backend; the nprobe ladder rung
+        # drops to 1 probe, and at the safe route cap that HALVES both
+        # the exchange budget and the rerank working set — the rung must
+        # fit its own smaller per-shard bound
+        from mpi_knn_tpu.serve.engine import (
+            SHARDED_SCRATCH_PARAMS,
+            lower_bucket,
+        )
+        from mpi_knn_tpu.ivf.sharded import sharded_query_shapes
+
+        if target.metric != "l2" or target.dtype != "float32":
+            raise UnsupportedTarget(
+                "the clustered (IVF) path is l2/float32 by its own "
+                "contract (ivf/index.py rejects other combinations)"
+            )
+        _require_sharded_mesh()
+        cfg = _sharded_cfg(target).replace(query_bucket=bucket, donate=True)
+        if target.ladder == "nprobe":
+            cfg = cfg.replace(nprobe=1)
+        index = _ivf_sharded_lint_index(_sharded_cfg(target))
+        cfg = index.compatible_cfg(cfg)
+        lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
+        _, _, route_cap = sharded_query_shapes(
+            cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket,
+            index.shards,
+        )
+        meta = {
+            **_ivf_sharded_meta(index, cfg, q_tile, route_cap),
+            "serve": True,
+            "donated_params": SHARDED_SCRATCH_PARAMS if cfg.donate else (),
+            "resident_bytes": index.nbytes_resident,
+        }
+        return lowered, cfg, meta
 
     if target.backend == "ivf":
         # the clustered index serves through the SAME bucket cache; its
@@ -509,6 +679,7 @@ _LOWERERS = {
     "ring-overlap": _lower_ring,
     "pallas": _lower_pallas,
     "ivf": _lower_ivf,
+    "ivf-sharded": _lower_ivf_sharded,
 }
 
 

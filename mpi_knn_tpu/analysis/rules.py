@@ -29,7 +29,11 @@ Shipped rules:
   pair, forward; bidir: two counter-directed pairs, 2 permutes per torus
   direction — wrong-direction or missing permutes are findings);
   single-device backends must contain no collectives at all (a stray
-  ``all-gather`` / ``all-reduce`` is a sharding leak).
+  ``all-gather`` / ``all-reduce`` is a sharding leak); sharded-IVF
+  programs contain exactly the candidate exchange's ``all-to-all``s
+  (count, full-ring replica groups, payload bytes ≤ the declared
+  per-tile exchange budget) and nothing else — an unrouted full-bucket
+  broadcast or an over-budget per-shard gather is a finding.
 - **R6-ivf-probe** — clustered-index probe discipline. In an IVF cell the
   only way corpus payload may reach a dot is the per-query probe gather:
   every batched candidate dot must carry a ``gather`` in its backward
@@ -331,6 +335,21 @@ class R2Memory(Rule):
         "parameter", "tuple", "get-tuple-element", "while", "opt-barrier",
         "conditional", "call",
     )
+    # sharded (SPMD) programs additionally pass the resident slice through
+    # the partitioner's annotation custom-calls (@Sharding and the
+    # full↔shard shape casts) — directives, not payload; every other
+    # custom-call (TopK, …) stays on the hook
+    _SPMD_ANNOTATIONS = (
+        'custom_call_target="Sharding"',
+        'custom_call_target="SPMDFullToShardShape"',
+        'custom_call_target="SPMDShardToFullShape"',
+    )
+
+    @classmethod
+    def _is_spmd_annotation(cls, instr) -> bool:
+        return instr.opcode == "custom-call" and any(
+            t in instr.attrs for t in cls._SPMD_ANNOTATIONS
+        )
 
     def check(self, ctx, stage, module) -> list[Finding]:
         entry_params = [
@@ -373,6 +392,8 @@ class R2Memory(Rule):
             for i in c.instructions.values():
                 if i.opcode in exempt:
                     continue  # inputs/plumbing: the caller's bytes, not new
+                if strict is not None and self._is_spmd_annotation(i):
+                    continue  # partitioner directives, not materialization
                 b = max_buffer_bytes(i.type_str)
                 if b > budget:
                     why = (
@@ -636,6 +657,31 @@ def permute_direction_census(module: HloModule, ring_n: int) -> dict:
     return out
 
 
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+
+
+def alltoall_census(module: HloModule, ring_n: int) -> dict:
+    """Account every ``all-to-all`` (the sharded-IVF candidate exchange's
+    collective): instruction count, total payload bytes (result buffer of
+    the tiled form — what one scan step moves per shard), and any
+    instruction whose replica_groups is NOT the single full-ring group —
+    a partial-group exchange would route candidates to a subset of the
+    owners the routing table named."""
+    full = "{" + ",".join(str(i) for i in range(ring_n)) + "}"
+    out: dict = {"count": 0, "bytes": 0, "bad_groups": []}
+    for comp, name in module.find("all-to-all"):
+        instr = module.instr(comp, name)
+        if instr.opcode.endswith("-done"):
+            continue
+        out["count"] += 1
+        out["bytes"] += max_buffer_bytes(instr.type_str)
+        m = _REPLICA_GROUPS_RE.search(instr.attrs)
+        groups = m.group(1).replace(" ", "") if m else ""
+        if groups != full:
+            out["bad_groups"].append(f"{comp}::{name} ({groups or 'none'})")
+    return out
+
+
 _WHILE_BODY_RE = re.compile(r"body=%?([\w.\-]+)")
 _WHILE_COND_RE = re.compile(r"condition=%?([\w.\-]+)")
 _INT_CONST_RE = re.compile(r"^\s*(-?\d+)\s*$")
@@ -879,17 +925,107 @@ class R4Collectives(Rule):
     description = (
         "ring programs contain exactly the corpus-rotation permutes "
         "(uni: one forward pair; bidir: two counter-directed pairs) with "
-        "ring-shaped source_target_pairs; single-device programs contain "
-        "no collectives — anything else is a sharding leak"
+        "ring-shaped source_target_pairs; sharded-IVF programs exactly "
+        "the candidate-exchange all-to-alls (full-ring groups, payload "
+        "inside the declared budget); single-device programs contain no "
+        "collectives — anything else is a sharding leak"
     )
 
     def applies(self, ctx) -> bool:
         return True
 
+    def _check_sharded_exchange(self, ctx, stage, module, found):
+        """The sharded-IVF accounting: the candidate exchange is EXACTLY
+        ``expected_alltoalls`` all-to-alls per tile (request table + the
+        rows/ids/norms returns), each over the single full-ring replica
+        group, with total payload bytes inside the declared per-tile
+        exchange budget. Anything else — a collective-permute (this
+        search has no rotation), an all-gather/broadcast (an unrouted
+        full-bucket exchange would re-centralize the corpus the sharding
+        exists to distribute), a partial replica group, or an over-budget
+        payload — is a finding."""
+        t = ctx.target
+        out = []
+        for op, hits in found.items():
+            if op == "all-to-all":
+                continue
+            out.append(
+                Finding(
+                    self.name,
+                    t.label,
+                    stage,
+                    f"sharded-clustered program contains a stray {op} "
+                    f"({len(hits)}×, e.g. {hits[0][1]}) — the only legal "
+                    "collective is the routed candidate exchange's "
+                    "all-to-all; an unrouted broadcast/gather would move "
+                    "whole bucket stores instead of routed candidates",
+                    {"op": op, "count": len(hits)},
+                )
+            )
+        census = alltoall_census(module, ctx.meta.get("shards", 0))
+        if stage == "before_opt":
+            expected = ctx.meta.get("expected_alltoalls")
+            if expected is not None and census["count"] != expected:
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"expected exactly {expected} all-to-alls per tile "
+                        "(request table + rows/ids/norms candidate "
+                        f"returns), found {census['count']}",
+                        {"count": census["count"]},
+                    )
+                )
+            for bad in census["bad_groups"]:
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"{bad} replica_groups is not the single full-"
+                        f"ring group over {ctx.meta.get('shards')} shards "
+                        "— a partial-group exchange cannot reach every "
+                        "owner the routing table names",
+                        {"shards": ctx.meta.get("shards")},
+                    )
+                )
+            budget = ctx.meta.get("exchange_bytes_tile")
+            if budget is not None and census["bytes"] > budget:
+                out.append(
+                    Finding(
+                        self.name,
+                        t.label,
+                        stage,
+                        f"candidate exchange moves {census['bytes']} bytes "
+                        f"per tile > the declared budget {budget} "
+                        "(shards·route_cap·(request + bucket payload)) — "
+                        "an over-budget per-shard gather is scanning more "
+                        "than it routed",
+                        {"bytes": census["bytes"], "budget": budget},
+                    )
+                )
+        elif census["count"] == 0:
+            out.append(
+                Finding(
+                    self.name,
+                    t.label,
+                    stage,
+                    "sharded-clustered program compiled to zero "
+                    "all-to-alls — the candidate exchange was optimized "
+                    "away (results can only be correct if no query ever "
+                    "probes a remote shard, i.e. they are not)",
+                    {},
+                )
+            )
+        return out
+
     def check(self, ctx, stage, module) -> list[Finding]:
         found = count_collectives(module)
         t = ctx.target
         out = []
+        if t.backend == "ivf-sharded":
+            return self._check_sharded_exchange(ctx, stage, module, found)
         if t.backend not in ("ring", "ring-overlap"):
             for op, hits in found.items():
                 out.append(
@@ -1052,7 +1188,10 @@ class R6IvfProbe(Rule):
     )
 
     def applies(self, ctx) -> bool:
-        return getattr(ctx.target, "backend", None) == "ivf"
+        # the sharded form keeps the same probe discipline: the routed
+        # exchange only ever moves gathered buckets, so every batched
+        # candidate dot still carries a gather in its backward slice
+        return getattr(ctx.target, "backend", None) in ("ivf", "ivf-sharded")
 
     def check(self, ctx, stage, module) -> list[Finding]:
         if stage != "before_opt":

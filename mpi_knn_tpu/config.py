@@ -196,6 +196,31 @@ class KNNConfig:
     kmeans_iters: int = 25
     kmeans_init: str = "kmeans++"
     ivf_seed: int = 0
+    # --- sharded clustered index (mpi_knn_tpu.ivf.sharded) ---------------
+    # ivf_shards: distribute the clustered index's bucket store over this
+    # many ring-mesh devices (TPU-KNN's deployment shape): each device
+    # owns a contiguous, capacity-balanced slice of the trained partitions
+    # at the same static bucket_cap layout, the (P, d) centroid table is
+    # replicated on every shard, and each query tile is scored at its home
+    # shard, routed to the devices owning its top-nprobe clusters via a
+    # static all-to-all candidate exchange, and reranked exactly at home.
+    # Corpus capacity scales with devices while per-query work stays
+    # sublinear — the first configuration that does both. None = the
+    # single-device clustered index (nothing changes). The shard layout is
+    # DERIVED from (partitions, shards), never stored: one saved index
+    # serves on any shard count.
+    ivf_shards: Optional[int] = None
+    # ivf_route_cap: static per-(home, owner)-shard route capacity of the
+    # candidate exchange, PER QUERY TILE. The all-to-all's shape must be
+    # static, so ragged routes pad up to this cap; probes beyond it are
+    # DROPPED (id −1 mask semantics — graceful recall loss, counted by the
+    # serving metrics as probe-cap overflow drops, never wrong answers).
+    # None = the safe cap q_tile·nprobe (no probe can ever drop, at the
+    # cost of a shards× exchange buffer); an explicit int trades bounded
+    # exchange memory (shards·cap·bucket_bytes per tile — what lint R2's
+    # per-shard strict budget prices) against drop risk under routing
+    # skew.
+    ivf_route_cap: Optional[int] = None
     # donate the per-batch top-k scratch to the serving executable
     # (donate_argnums): XLA aliases the scratch buffers to the outputs
     # (machine-checked from the module's input_output_alias by lint rule
@@ -295,6 +320,28 @@ class KNNConfig:
             raise ValueError(
                 f"kmeans_iters must be >= 1, got {self.kmeans_iters}"
             )
+        if self.ivf_shards is not None:
+            if self.partitions is None:
+                raise ValueError(
+                    "ivf_shards without partitions is meaningless: sharding "
+                    "distributes a clustered index's partition buckets over "
+                    "the ring mesh — set partitions too"
+                )
+            if self.ivf_shards < 1:
+                raise ValueError(
+                    f"ivf_shards must be >= 1, got {self.ivf_shards}"
+                )
+        if self.ivf_route_cap is not None:
+            if self.ivf_shards is None:
+                raise ValueError(
+                    "ivf_route_cap without ivf_shards is meaningless: the "
+                    "route cap bounds the sharded candidate exchange — on "
+                    "a single-device clustered index nothing is routed"
+                )
+            if self.ivf_route_cap < 1:
+                raise ValueError(
+                    f"ivf_route_cap must be >= 1, got {self.ivf_route_cap}"
+                )
         if self.topk_block < 1:
             raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")
         if self.k < 1:

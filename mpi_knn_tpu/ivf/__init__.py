@@ -16,9 +16,17 @@ Public surface::
     save_ivf_index(idx, "corpus.ivf.npz")
     idx = load_ivf_index("corpus.ivf.npz")
 
-Design rationale: DESIGN.md "The ladder" rung 4; the machine-checked
-probed-bytes and probe-gather-feeds-the-exact-dot contracts are lint
-rules R2/R6 (``mpi_knn_tpu/analysis/README.md``).
+    # sharded over the ring mesh (TPU-KNN's deployment shape): capacity
+    # scales with devices, per-query work stays sublinear, the candidate
+    # exchange is a static all-to-all (DESIGN.md ladder rung 5)
+    sidx = build_ivf_index(X, KNNConfig(k=10, partitions=64, ivf_shards=4))
+    sidx = shard_ivf_index(load_ivf_index("corpus.ivf.npz"), shards=2)
+    d, i, stats = search_ivf_sharded(sidx, Q)
+
+Design rationale: DESIGN.md "The ladder" rungs 4–5; the machine-checked
+probed-bytes (per shard, in the sharded case), probe-gather and
+exchange-accounting contracts are lint rules R2/R4/R6
+(``mpi_knn_tpu/analysis/README.md``).
 """
 
 from mpi_knn_tpu.ivf.index import (
@@ -30,15 +38,27 @@ from mpi_knn_tpu.ivf.index import (
 )
 from mpi_knn_tpu.ivf.kmeans import KMeansResult, kmeans
 from mpi_knn_tpu.ivf.search import ivf_query_tile, search_ivf
+from mpi_knn_tpu.ivf.sharded import (
+    ShardedIVFIndex,
+    build_sharded_ivf_index,
+    search_ivf_sharded,
+    shard_ivf_index,
+    unshard_ivf_index,
+)
 
 __all__ = [
     "IVFIndex",
     "KMeansResult",
+    "ShardedIVFIndex",
     "build_ivf_index",
+    "build_sharded_ivf_index",
     "ivf_query_tile",
     "kmeans",
     "load_ivf_index",
     "save_ivf_index",
     "search_ivf",
+    "search_ivf_sharded",
+    "shard_ivf_index",
     "tune_nprobe",
+    "unshard_ivf_index",
 ]

@@ -6,12 +6,19 @@ The offline half of the sublinear serving story: cluster once here, then
 through the bucketed AOT executable cache (zero steady-state compiles,
 probed bytes per query = nprobe/partitions of the corpus).
 
+``--backend ring`` is accepted and means the SHARDED deployment shape
+(`mpi_knn_tpu.ivf.sharded`): training is still single-device math
+(clustering is layout-independent), the saved ``.npz`` is identical, and
+the shard layout is DERIVED at serve time from ``--devices`` — one
+artifact serves on any shard count (``mpi-knn query --index-load …
+--backend ring --devices 4``).
+
 Flag combinations the clustered path cannot honor are refused with a loud
 exit 2 (the serve-CLI convention — never silently build a different index
-than the one requested): a non-serial backend (the pallas kernels and the
-ring rotation scan the full corpus by construction), a non-L2 metric (the
-k-means partitioner is L2 geometry), float64 (the dense backends' debug
-mode), nprobe > partitions.
+than the one requested): a pallas backend (the fused kernels scan the
+full corpus by construction), a non-L2 metric (the k-means partitioner is
+L2 geometry), float64 (the dense backends' debug mode),
+nprobe > partitions.
 
 Examples::
 
@@ -19,6 +26,8 @@ Examples::
     mpi-knn build-index --data corpus.mat --partitions 64 --nprobe 8 \
         --out corpus.ivf.npz
     mpi-knn query --data sift:100000 --index-load sift.ivf.npz --synthetic 4096
+    mpi-knn query --data sift:100000 --index-load sift.ivf.npz \
+        --backend ring --devices 4 --synthetic 4096   # sharded serving
 """
 
 from __future__ import annotations
@@ -60,8 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="l2 only — cosine is refused loudly (the k-means "
                    "partitioner and centroid score are L2 geometry)")
     k.add_argument("--backend", default="auto",
-                   help="serial/auto only — the clustered search is a "
-                   "single-device path; other backends are refused")
+                   help="serial/auto (single-device) or ring (the sharded "
+                   "deployment shape — training is identical; the shard "
+                   "layout is derived at serve time, so the saved index "
+                   "is the same artifact); pallas is refused")
     k.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="bucket-store at-rest dtype; bfloat16 halves "
@@ -97,11 +108,20 @@ def main(argv=None) -> int:
 
     X, _, source = load_corpus(args.data, limit=args.limit)
 
+    # --backend ring = the sharded deployment shape: the k-means training
+    # and the saved artifact are IDENTICAL (the shard layout is derived at
+    # serve time), so the build itself runs the single-device path — the
+    # old exit-2 refusal is lifted into real support, not silently mapped
+    backend = args.backend
+    sharded = backend in ("ring", "ring-overlap")
+    if sharded:
+        backend = "auto"
+
     try:
         cfg = KNNConfig(
             k=args.k,
             metric=args.metric,
-            backend=args.backend,
+            backend=backend,
             dtype=args.dtype,
             recall_target=args.recall_target,
             partitions=args.partitions,
@@ -142,6 +162,13 @@ def main(argv=None) -> int:
             f"{100 * frac:.1f}% of corpus bytes/query; "
             f"train+tune {build_s:.2f}s; saved {path}"
         )
+        if sharded:
+            print(
+                "[mpi-knn build-index] --backend ring noted: the shard "
+                "layout is derived at serve time — serve this artifact "
+                "with `mpi-knn query --index-load ... --backend ring "
+                "--devices N` on any shard count"
+            )
     return 0
 
 

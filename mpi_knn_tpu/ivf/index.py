@@ -194,6 +194,13 @@ def build_ivf_index(
     from mpi_knn_tpu.serve.index import CorpusIndex
 
     cfg = (config or KNNConfig()).replace(**overrides)
+    if cfg.ivf_shards is not None:
+        # the sharded-clustered axis: train here (single-device math —
+        # clustering is layout-independent), then distribute over the
+        # ring mesh (ivf/sharded.py derives the layout)
+        from mpi_knn_tpu.ivf.sharded import build_sharded_ivf_index
+
+        return build_sharded_ivf_index(corpus, cfg)
     if cfg.partitions is None:
         raise ValueError(
             "building a clustered index requires partitions "
@@ -351,9 +358,16 @@ def tune_nprobe(
     return hi, hi_rec
 
 
-def save_ivf_index(index: IVFIndex, path: str) -> str:
+def save_ivf_index(index, path: str) -> str:
     """Write the full index to one ``.npz`` (bit-identical round trip;
-    bf16 buckets travel as uint16 views). Returns the path written."""
+    bf16 buckets travel as uint16 views). A :class:`~mpi_knn_tpu.ivf.
+    sharded.ShardedIVFIndex` saves through its single-device view — the
+    shard layout is DERIVED, never stored, so one artifact reloads and
+    serves on any shard count. Returns the path written."""
+    if getattr(index, "backend", None) == "ivf-sharded":
+        from mpi_knn_tpu.ivf.sharded import unshard_ivf_index
+
+        index = unshard_ivf_index(index)
     if not path.endswith(".npz"):
         path += ".npz"
     buckets = np.asarray(index.buckets)

@@ -64,38 +64,16 @@ def _compress_keys_batched(q_x, q_sq, rows, row_sqs):
     return q_sq[:, None] - 2.0 * xy + row_sqs
 
 
-def ivf_query_tile(
-    q_x: jax.Array,  # (q_tile, d)
-    q_ids: jax.Array,  # (q_tile,)
-    centroids: jax.Array,  # (P, d) f32
-    centroid_sqs: jax.Array,  # (P,)
-    buckets: jax.Array,  # (P, cap, d) at-rest dtype
-    bucket_ids: jax.Array,  # (P, cap) int32, -1 padding
-    bucket_sqs: jax.Array,  # (P, cap) f32 exact norms
-    cfg: KNNConfig,
-    nprobe: int,
-):
-    """One query tile through the two-stage search → ((q_tile, k) dists
-    ascending, ids). The single tile body behind the one-shot wrapper,
-    the serving engine's bucket-cache cells, and the lint lowering."""
-    acc = jnp.float32
-    q_x = q_x.astype(acc)
-    q_sq = sq_norms(q_x)
-    cd = pairwise_sq_l2(
-        q_x, centroids, x_sq=q_sq, y_sq=centroid_sqs,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    _, probe = jax.lax.top_k(-cd, nprobe)  # (q_tile, nprobe)
-    cap = buckets.shape[1]
-    v = nprobe * cap
-    rows = jnp.take(buckets, probe, axis=0).reshape(-1, v, buckets.shape[2])
-    ids = jnp.take(bucket_ids, probe, axis=0).reshape(-1, v)
-    sqs = jnp.take(bucket_sqs, probe, axis=0).reshape(-1, v)
-    rows = rows.astype(acc)
+def finish_candidates(q_x, q_ids, q_sq, rows, ids, sqs, cfg: KNNConfig):
+    """Stage-3 finish over gathered candidates — shared by the
+    single-device tile body and the sharded routed tile
+    (``ivf/sharded.py``), so the two paths can never drift: under
+    ``precision_policy="mixed"`` a bf16 DEFAULT compress dot overfetches
+    4k of the (q_tile, v, d) candidates (id-based masks on compressed
+    keys, zero-by-value deferred — the ops/rerank.py masking split), then
+    the survivors hit the shared exact HIGHEST rerank top-k."""
+    v = ids.shape[1]
     if cfg.precision_policy == "mixed" and mixed_applies(cfg.k, v):
-        # compress-and-rerank over the gathered candidates: id-based masks
-        # on the compressed keys, zero-by-value deferred to exact values
-        # (the ops/rerank.py masking split)
         keys = _compress_keys_batched(q_x, q_sq, rows, sqs)
         keys = mask_tile(
             keys,
@@ -121,6 +99,45 @@ def ivf_query_tile(
         exclude_zero=cfg.exclude_zero,
         zero_eps=cfg.zero_eps,
     )
+
+
+def score_centroids(q_x, centroids, centroid_sqs, nprobe: int):
+    """Stage-1 routing decision, shared with the sharded path: exact
+    HIGHEST centroid score + static-shape top-nprobe. Returns
+    (q_sq, (q_tile, nprobe) partition ids)."""
+    q_sq = sq_norms(q_x)
+    cd = pairwise_sq_l2(
+        q_x, centroids, x_sq=q_sq, y_sq=centroid_sqs,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    _, probe = jax.lax.top_k(-cd, nprobe)
+    return q_sq, probe
+
+
+def ivf_query_tile(
+    q_x: jax.Array,  # (q_tile, d)
+    q_ids: jax.Array,  # (q_tile,)
+    centroids: jax.Array,  # (P, d) f32
+    centroid_sqs: jax.Array,  # (P,)
+    buckets: jax.Array,  # (P, cap, d) at-rest dtype
+    bucket_ids: jax.Array,  # (P, cap) int32, -1 padding
+    bucket_sqs: jax.Array,  # (P, cap) f32 exact norms
+    cfg: KNNConfig,
+    nprobe: int,
+):
+    """One query tile through the two-stage search → ((q_tile, k) dists
+    ascending, ids). The single tile body behind the one-shot wrapper,
+    the serving engine's bucket-cache cells, and the lint lowering."""
+    acc = jnp.float32
+    q_x = q_x.astype(acc)
+    q_sq, probe = score_centroids(q_x, centroids, centroid_sqs, nprobe)
+    cap = buckets.shape[1]
+    v = nprobe * cap
+    rows = jnp.take(buckets, probe, axis=0).reshape(-1, v, buckets.shape[2])
+    ids = jnp.take(bucket_ids, probe, axis=0).reshape(-1, v)
+    sqs = jnp.take(bucket_sqs, probe, axis=0).reshape(-1, v)
+    rows = rows.astype(acc)
+    return finish_candidates(q_x, q_ids, q_sq, rows, ids, sqs, cfg)
 
 
 def ivf_serve_chunk(

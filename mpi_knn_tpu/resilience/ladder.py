@@ -114,9 +114,12 @@ def build_ladder(index, cfg, policy: ResiliencePolicy):
     rungs = [(FULL_RUNG, cfg)]
     cur = cfg
 
-    # rung: probe half as many partitions (clustered index only)
+    # rung: probe half as many partitions (clustered index only — the
+    # sharded form shares it; at the safe route cap halving nprobe also
+    # halves the candidate-exchange buffers, so the rung sheds ICI bytes
+    # along with probed bytes)
     if (
-        getattr(index, "backend", None) == "ivf"
+        getattr(index, "backend", None) in ("ivf", "ivf-sharded")
         and cur.nprobe is not None
         and cur.nprobe > 1
     ):

@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--nprobe", type=int, default=None,
                    help="with --index-load: partitions probed per query "
                    "(default: the index's tuned value)")
+    d.add_argument("--route-cap", type=int, default=None,
+                   help="with --index-load --backend ring: static "
+                   "per-(home, owner)-shard route capacity of the "
+                   "candidate exchange per query tile (default: the safe "
+                   "cap q_tile*nprobe — no probe ever drops); smaller "
+                   "caps bound exchange memory and DROP overflow probes "
+                   "(counted in the metrics/report, never wrong answers)")
     q = p.add_mutually_exclusive_group()
     q.add_argument("--queries", default=None,
                    help=".npy/.mat/.fvecs file of query points, streamed "
@@ -285,7 +292,13 @@ def main(argv=None) -> int:
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
 
-        force_platform(args.platform)
+        # --platform cpu --devices N: size the virtual host mesh to the
+        # request (a ring/sharded serve on a 1-CPU host would otherwise
+        # fail with "only 1 visible" despite the explicit ask)
+        force_platform(
+            args.platform,
+            n_devices=(args.devices if args.platform == "cpu" else None),
+        )
 
     from mpi_knn_tpu.cli import load_corpus
     from mpi_knn_tpu.serve import ServeSession, build_index
@@ -300,6 +313,11 @@ def main(argv=None) -> int:
         # clustered index would be silently ignored
         print("error: --nprobe requires --index-load (probing is a "
               "clustered-index knob)", file=sys.stderr)
+        return 2
+    if args.route_cap is not None:
+        print("error: --route-cap requires --index-load --backend ring "
+              "(the route cap bounds the sharded clustered candidate "
+              "exchange)", file=sys.stderr)
         return 2
 
     try:
@@ -340,19 +358,24 @@ def main(argv=None) -> int:
 
 def _serve_loaded_index(args, X, source, policy=None) -> int:
     """``--index-load``: serve a saved clustered (IVF) index through the
-    same session/bucket-cache machinery. Corpus-side knobs come from the
-    saved index; explicitly conflicting flags are refused with the
-    standard loud exit 2 (never silently serve a different configuration
-    than the one requested)."""
+    same session/bucket-cache machinery — single-device by default, or
+    SHARDED over the ring mesh with ``--backend ring`` (the shard layout
+    is derived from ``--devices``; one artifact serves on any shard
+    count). Corpus-side knobs come from the saved index; explicitly
+    conflicting flags are refused with the standard loud exit 2 (never
+    silently serve a different configuration than the one requested)."""
     from mpi_knn_tpu.ivf import load_ivf_index
     from mpi_knn_tpu.serve import ServeSession
 
-    if args.backend not in ("auto", "serial"):
+    sharded = args.backend == "ring"
+    if args.backend not in ("auto", "serial", "ring"):
         print(
-            f"error: --index-load serves a clustered (IVF) index — a "
-            f"single-device serial-math path; --backend {args.backend} "
-            "cannot honor it (the pallas kernels and the ring rotation "
-            "scan the full corpus by construction)",
+            f"error: --index-load × --backend {args.backend} is not "
+            "supported: a clustered index serves single-device (serial/"
+            "auto) or sharded over the ring mesh (ring — the routed "
+            "candidate exchange); the pallas kernels scan the full "
+            "corpus by construction, and the exchange has no overlap "
+            "schedule (use --backend ring, not ring-overlap)",
             file=sys.stderr,
         )
         return 2
@@ -364,9 +387,17 @@ def _serve_loaded_index(args, X, source, policy=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.devices is not None:
-        print("error: --devices has no meaning with --index-load (the "
-              "clustered search is single-device)", file=sys.stderr)
+    if args.devices is not None and not sharded:
+        print("error: --devices with --index-load requires --backend "
+              "ring (the shard count of the distributed clustered "
+              "index); the single-device clustered search cannot honor "
+              "it", file=sys.stderr)
+        return 2
+    if args.route_cap is not None and not sharded:
+        print("error: --route-cap with --index-load requires --backend "
+              "ring: the route cap bounds the sharded candidate "
+              "exchange — nothing is routed single-device",
+              file=sys.stderr)
         return 2
     if args.corpus_tile is not None:
         print("error: --corpus-tile has no meaning with --index-load "
@@ -402,6 +433,16 @@ def _serve_loaded_index(args, X, source, policy=None) -> int:
         )
         return 2
     try:
+        if sharded:
+            # derive the shard layout over the mesh — the saved artifact
+            # carries no layout, so the SAME .npz serves here at any
+            # --devices count (bit-compatibly: every per-query dot shape
+            # is shard-count-independent)
+            from mpi_knn_tpu.ivf import shard_ivf_index
+
+            index = shard_ivf_index(
+                index, shards=args.devices, route_cap=args.route_cap
+            )
         cfg = index.compatible_cfg(
             index.cfg.replace(
                 k=args.k,
@@ -418,7 +459,7 @@ def _serve_loaded_index(args, X, source, policy=None) -> int:
         session = ServeSession(index, cfg, resilience=policy)
     except ValueError as e:
         # unhonorable combination (nprobe > partitions, mixed policy on a
-        # bf16-at-rest index, …)
+        # bf16-at-rest index, more shards than devices, …)
         print(f"error: {e}", file=sys.stderr)
         return 2
     load_s = time.perf_counter() - t0
@@ -477,12 +518,27 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         "latency_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
         if len(lats) else None,
     }
-    if index.backend == "ivf":
+    if index.backend in ("ivf", "ivf-sharded"):
         summary["partitions"] = index.partitions
         summary["nprobe"] = cfg.nprobe
         summary["probe_fraction"] = round(
             cfg.nprobe / index.partitions, 4
         )
+    if session.exchange is not None:
+        # the sharded candidate-exchange story, summarized where the
+        # round is read: routed probe volume, the (counted, loud) probe-
+        # cap overflow drops, static exchange bytes, and the per-shard
+        # served-request load — the skew an operator tunes partitions/
+        # route caps against
+        summary["sharded"] = {
+            "shards": session.exchange["shards"],
+            "route_cap": cfg.ivf_route_cap,  # None = safe (no drops)
+            "routed_total": session.exchange["routed_total"],
+            "overflow_dropped_total": session.exchange["dropped_total"],
+            "exchange_bytes_total":
+                session.exchange["exchange_bytes_total"],
+            "served_per_shard": session.exchange["served_per_shard"],
+        }
     if args.profile_batches:
         # batches replay the stream's shape (--batch rows,
         # corpus-distributed synthetic noise); session.profile compiles

@@ -151,6 +151,24 @@ def _ivf_jits():
     return _make_jits(ivf_serve_chunk, ("cfg", "nprobe"))
 
 
+def _ivf_sharded_jits():
+    # the sharded-clustered serve fn carries a THIRD donated scratch (the
+    # per-shard exchange-stats vector) so its three outputs all alias
+    # donated inputs — donate_argnums=(2, 3, 4), not the uniform (2, 3)
+    from mpi_knn_tpu.ivf.sharded import ivf_sharded_serve_chunk
+
+    return {
+        donate: jax.jit(
+            ivf_sharded_serve_chunk,
+            static_argnames=(
+                "cfg", "nprobe", "mesh", "axis", "shards", "route_cap"
+            ),
+            donate_argnums=(2, 3, 4) if donate else (),
+        )
+        for donate in (False, True)
+    }
+
+
 @functools.lru_cache(maxsize=None)
 def _jits(backend: str):
     if backend == "serial":
@@ -161,6 +179,8 @@ def _jits(backend: str):
         return _pallas_jits()
     if backend == "ivf":
         return _ivf_jits()
+    if backend == "ivf-sharded":
+        return _ivf_sharded_jits()
     raise ValueError(f"no serving path for backend {backend!r}")
 
 
@@ -184,11 +204,16 @@ class _BucketExec:
     # this executable (serving queries carry no corpus identity) and is
     # NOT donated — built once here instead of re-uploaded per submit
     qids: jax.Array | None = None
-    # ring only: a once-compiled carry initializer with the query
-    # sharding as out_shardings — the scratch IS donated (fresh buffers
-    # per batch), but building it on the default device and resharding
-    # would pay an allocate-then-copy on every submit
+    # ring/ivf-sharded only: a once-compiled carry initializer with the
+    # query sharding as out_shardings — the scratch IS donated (fresh
+    # buffers per batch), but building it on the default device and
+    # resharding would pay an allocate-then-copy on every submit
     make_carry: object | None = None
+    # ivf-sharded only: the resolved static route cap and the (static)
+    # bytes its four all-to-alls move per batch — stamped into the
+    # exchange-bytes counter without reading the device
+    route_cap: int | None = None
+    exchange_bytes: int | None = None
 
 
 def _acc_dtype(cfg: KNNConfig):
@@ -311,12 +336,47 @@ def _ivf_lowered(index, cfg: KNNConfig, bucket: int):
     return lowered, q_pad, q_tile
 
 
+def _ivf_sharded_lowered(index, cfg: KNNConfig, bucket: int):
+    """Per-batch program for a sharded clustered index — the routed
+    two-stage search under shard_map, with the per-shard exchange stats
+    as a third donated scratch (``ivf/sharded.py``)."""
+    from mpi_knn_tpu.ivf.sharded import N_STATS, sharded_query_shapes
+
+    nprobe = cfg.nprobe
+    q_tile, q_pad, route_cap = sharded_query_shapes(
+        cfg, nprobe, index.bucket_cap, index.dim, bucket, index.shards
+    )
+    qt = q_pad // q_tile
+    qsh = NamedSharding(index.mesh, jax.sharding.PartitionSpec(index.axis))
+    sds = jax.ShapeDtypeStruct
+    lowered = _jits("ivf-sharded")[cfg.donate].lower(
+        sds((qt, q_tile, index.dim), jnp.float32, sharding=qsh),
+        sds((qt, q_tile), jnp.int32, sharding=qsh),
+        sds((qt, q_tile, cfg.k), jnp.float32, sharding=qsh),
+        sds((qt, q_tile, cfg.k), jnp.int32, sharding=qsh),
+        sds((N_STATS * index.shards,), jnp.int32, sharding=qsh),
+        index.centroids,
+        index.centroid_sqs,
+        index.buckets,
+        index.bucket_ids,
+        index.bucket_sqs,
+        cfg,
+        nprobe,
+        index.mesh,
+        index.axis,
+        index.shards,
+        route_cap,
+    )
+    return lowered, q_pad, q_tile
+
+
 _LOWER_BUILDERS = {
     "serial": _serial_lowered,
     "ring": _ring_lowered,
     "ring-overlap": _ring_lowered,
     "pallas": _pallas_lowered,
     "ivf": _ivf_lowered,
+    "ivf-sharded": _ivf_sharded_lowered,
 }
 
 
@@ -330,8 +390,11 @@ def lower_bucket(index: CorpusIndex, cfg: KNNConfig, bucket: int):
 
 
 # donate_argnums of every serving function (the carry scratch); the lint
-# engine's R5 reads this to know which parameters MUST carry an alias
+# engine's R5 reads this to know which parameters MUST carry an alias.
+# The sharded-clustered fn adds the exchange-stats scratch as a third
+# donated param so all three of its outputs alias donated inputs.
 SCRATCH_PARAMS = (2, 3)
+SHARDED_SCRATCH_PARAMS = (2, 3, 4)
 
 
 def _fingerprint_cfg(cfg: KNNConfig) -> KNNConfig:
@@ -364,6 +427,7 @@ def get_executable(
         try:
             lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
             qsh = None
+            route_cap = exchange_bytes = None
             if index.backend in ("ring", "ring-overlap"):
                 from mpi_knn_tpu.backends.ring import _query_spec
 
@@ -381,9 +445,34 @@ def get_executable(
                     ),
                     out_shardings=(qsh, qsh),
                 )
+            if index.backend == "ivf-sharded":
+                from jax.sharding import PartitionSpec
+                from mpi_knn_tpu.ivf.sharded import (
+                    exchange_bytes_per_tile,
+                    scratch_maker,
+                    sharded_query_shapes,
+                )
+
+                qsh = NamedSharding(index.mesh, PartitionSpec(index.axis))
+                qt = q_pad // q_tile
+                _, _, route_cap = sharded_query_shapes(
+                    cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket,
+                    index.shards,
+                )
+                exchange_bytes = qt * exchange_bytes_per_tile(
+                    index.shards, route_cap, index.bucket_cap, index.dim,
+                    index.buckets.dtype.itemsize,
+                )
+                qids = jax.device_put(
+                    jnp.full((qt, q_tile), -1, jnp.int32), qsh
+                )
+                make_carry = scratch_maker(
+                    qt, q_tile, cfg.k, index.shards, index.mesh, index.axis
+                )
             exec_ = _BucketExec(
                 lowered.compile(), bucket, q_pad, q_tile, cfg, index.backend,
                 q_sharding=qsh, qids=qids, make_carry=make_carry,
+                route_cap=route_cap, exchange_bytes=exchange_bytes,
             )
         except Exception as e:
             # a raised lowering/compile failure is survivable by the
@@ -420,7 +509,8 @@ def _prep_queries(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q):
     # computes (and takes queries) in f32 — bf16-rounding the queries here
     # would silently change the math vs the one-shot search_ivf path
     dtype = (
-        jnp.float32 if exec_.backend == "ivf" else jnp.dtype(cfg.dtype)
+        jnp.float32 if exec_.backend in ("ivf", "ivf-sharded")
+        else jnp.dtype(cfg.dtype)
     )
     on_device = isinstance(q, jax.Array)
     if cfg.center and cfg.metric == "l2" and index.mu is not None:
@@ -429,6 +519,21 @@ def _prep_queries(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q):
         q = q - index.mu if (on_device or isinstance(index.mu, jax.Array)) \
             else np.asarray(q) - index.mu
         on_device = isinstance(q, jax.Array)
+    if exec_.backend == "ivf-sharded":
+        # tiles shaped on host when possible (one H2D straight onto the
+        # query sharding, zero per-shape reshape programs); a device
+        # batch pays a shard-local reshape op, cached per bucket shape
+        qt = exec_.q_pad // exec_.q_tile
+        if on_device:
+            q3 = pad_rows_any(q, exec_.q_pad, dtype=dtype).reshape(
+                qt, exec_.q_tile, index.dim
+            )
+        else:
+            qh = np.asarray(q, dtype=dtype)
+            q3 = np.pad(qh, ((0, exec_.q_pad - rows), (0, 0))).reshape(
+                qt, exec_.q_tile, index.dim
+            )
+        return jax.device_put(q3, exec_.q_sharding), exec_.qids, rows
     if on_device:
         q2d = pad_rows_any(q, exec_.q_pad, dtype=dtype)
         if exec_.q_sharding is not None:
@@ -450,7 +555,9 @@ def _prep_queries(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q):
 
 def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
     """Issue one padded batch on the compiled executable; returns padded
-    (q_pad, k) device results (async — not synchronized here)."""
+    ((q_pad, k) dists, ids, exchange_stats-or-None) device results
+    (async — not synchronized here). The stats slot is populated only by
+    the sharded-clustered backend (its per-shard (N_STATS·S,) vector)."""
     acc = _acc_dtype(cfg)
     if exec_.backend == "serial":
         qt = exec_.q_pad // exec_.q_tile
@@ -464,7 +571,11 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             index.tile_ids,
             index.tile_sqs,
         )
-        return d.reshape(exec_.q_pad, cfg.k), i.reshape(exec_.q_pad, cfg.k)
+        return (
+            d.reshape(exec_.q_pad, cfg.k),
+            i.reshape(exec_.q_pad, cfg.k),
+            None,
+        )
     if exec_.backend == "ivf":
         qt = exec_.q_pad // exec_.q_tile
         carry_d, carry_i = init_topk_tiles(
@@ -481,20 +592,39 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             index.bucket_ids,
             index.bucket_sqs,
         )
-        return d.reshape(exec_.q_pad, cfg.k), i.reshape(exec_.q_pad, cfg.k)
+        return (
+            d.reshape(exec_.q_pad, cfg.k),
+            i.reshape(exec_.q_pad, cfg.k),
+            None,
+        )
+    if exec_.backend == "ivf-sharded":
+        # q2d arrives pre-tiled (QT, q_tile, d) on the query sharding
+        carry_d, carry_i, stats0 = exec_.make_carry()
+        d, i, stats = exec_.compiled(
+            q2d, qids, carry_d, carry_i, stats0,
+            index.centroids, index.centroid_sqs, index.buckets,
+            index.bucket_ids, index.bucket_sqs,
+        )
+        return (
+            d.reshape(exec_.q_pad, cfg.k),
+            i.reshape(exec_.q_pad, cfg.k),
+            stats,
+        )
     if exec_.backend in ("ring", "ring-overlap"):
         # scratch born directly under the query sharding (no allocate-
         # then-reshard per batch); fresh buffers every call because the
         # executable consumes them (donation)
         carry_d, carry_i = exec_.make_carry()
-        return exec_.compiled(
+        d, i = exec_.compiled(
             q2d, qids, carry_d, carry_i,
             index.corpus_sharded, index.corpus_ids_sharded,
         )
+        return d, i, None
     carry_d, carry_i = init_topk(exec_.q_pad, cfg.k, dtype=acc)
-    return exec_.compiled(
+    d, i = exec_.compiled(
         q2d, qids, carry_d, carry_i, index.corpus_padded
     )
+    return d, i, None
 
 
 @dataclasses.dataclass
@@ -523,6 +653,11 @@ class BatchResult:
     retries: int = 0
     backoffs: tuple = ()
     deadline_breached: bool = False
+    # sharded-clustered batches only: the device (N_STATS·S,) exchange
+    # stats vector (routed/dropped/served per shard) + the executable's
+    # static per-batch exchange bytes
+    stats_padded: jax.Array | None = None
+    exchange_bytes: int | None = None
 
     @functools.cached_property
     def dists(self) -> np.ndarray:
@@ -531,6 +666,20 @@ class BatchResult:
     @functools.cached_property
     def ids(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.ids_padded))[: self.rows]
+
+    @functools.cached_property
+    def exchange(self) -> np.ndarray | None:
+        """Per-shard (S, N_STATS) [routed, dropped, served] exchange
+        stats of a sharded-clustered batch (None elsewhere). Counts are
+        over the PADDED batch — bucket padding rows route like real
+        rows, deterministically."""
+        if self.stats_padded is None:
+            return None
+        from mpi_knn_tpu.ivf.sharded import N_STATS
+
+        return np.asarray(
+            jax.device_get(self.stats_padded)
+        ).reshape(-1, N_STATS)
 
 
 def query_knn(
@@ -558,11 +707,48 @@ def query_knn(
     bucket = bucket_rows(nq, cfg.query_bucket)
     exec_ = get_executable(index, cfg, bucket)
     q2d, qids, rows = _prep_queries(index, cfg, exec_, queries)
-    d, i = _run(index, cfg, exec_, q2d, qids)
+    d, i, stats = _run(index, cfg, exec_, q2d, qids)
+    if stats is not None:
+        _count_exchange(stats, exec_.exchange_bytes)
     return KNNResult(
         dists=np.asarray(jax.device_get(d))[:rows],
         ids=np.asarray(jax.device_get(i))[:rows],
     )
+
+
+def _count_exchange(stats, exchange_bytes: int | None,
+                    registry=None) -> np.ndarray:
+    """Stamp one sharded batch's candidate-exchange story into the
+    metrics registry: routed candidate rows (histogram + counter),
+    probe-cap overflow drops (counter — a nonzero here is recall being
+    spent on routing skew), and the static exchange bytes. Returns the
+    per-shard (S, N_STATS) array for callers that also want it."""
+    from mpi_knn_tpu.ivf.sharded import N_STATS
+
+    reg = registry or obs_metrics.get_registry()
+    per_shard = np.asarray(jax.device_get(stats)).reshape(-1, N_STATS)
+    routed = int(per_shard[:, 0].sum())
+    dropped = int(per_shard[:, 1].sum())
+    reg.counter(
+        "serve_exchange_routed_total",
+        help="probe routes exchanged between shards (padded batches)",
+    ).inc(routed)
+    reg.histogram(
+        "serve_exchange_routed_per_batch",
+        help="probe routes exchanged per sharded batch",
+    ).observe(routed)
+    reg.counter(
+        "serve_exchange_overflow_dropped_total",
+        help="probes dropped at the static per-shard route cap "
+        "(counted recall loss, never wrong answers)",
+    ).inc(dropped)
+    if exchange_bytes:
+        reg.counter(
+            "serve_exchange_bytes_total",
+            help="bytes moved by the candidate-exchange all-to-alls "
+            "(static per executable)",
+        ).inc(exchange_bytes)
+    return per_shard
 
 
 class ServeSession:
@@ -623,6 +809,18 @@ class ServeSession:
         self.degradations: list[dict] = []  # rung-shed events, in order
         self.retries_total = 0
         self.deadline_breaches = 0
+        # sharded-clustered sessions accumulate the candidate-exchange
+        # story (routed/dropped totals, static exchange bytes, per-shard
+        # served-request load) for the CLI report; None elsewhere
+        self.exchange: dict | None = None
+        if getattr(index, "backend", None) == "ivf-sharded":
+            self.exchange = {
+                "shards": index.shards,
+                "routed_total": 0,
+                "dropped_total": 0,
+                "exchange_bytes_total": 0,
+                "served_per_shard": [0] * index.shards,
+            }
 
     @property
     def rung(self) -> str:
@@ -653,6 +851,15 @@ class ServeSession:
         self.queries_served = 0
         self.retries_total = 0
         self.deadline_breaches = 0
+        if self.exchange is not None:
+            # the candidate-exchange story is part of the window: totals
+            # spanning a warm-up batch would overstate routed volume
+            self.exchange.update(
+                routed_total=0,
+                dropped_total=0,
+                exchange_bytes_total=0,
+                served_per_shard=[0] * self.exchange["shards"],
+            )
 
     def _check_sentinel(self, res: BatchResult) -> None:
         """NaN/all-inf sentinel on a retired batch's REAL rows. NaN in a
@@ -664,6 +871,14 @@ class ServeSession:
         d = res.dists  # strips padding; cached, so retire pays D2H once
         bad_nan = bool(np.isnan(d).any())
         bad_inf = bool(d.size) and bool(np.isinf(d).all(axis=1).any())
+        if bad_inf and not bad_nan and res.exchange is not None \
+                and res.exchange[:, 1].sum() > 0:
+            # sharded batch under probe-cap overflow: a query whose every
+            # probe was dropped legitimately retires all-inf — that is
+            # the DOCUMENTED graceful recall loss (counted per shard in
+            # the exchange stats and the overflow-drop counter), not a
+            # poisoned tile. NaN still trips unconditionally.
+            bad_inf = False
         if bad_nan or bad_inf:
             kind = "NaN" if bad_nan else "all-inf row"
             obs_spans.event(
@@ -751,12 +966,41 @@ class ServeSession:
                     error="poisoned-result",
                 )
                 raise
+        extra = {}
+        if res.stats_padded is not None:
+            # the candidate-exchange story, stamped at retire (the batch
+            # is already synchronized — reading the tiny stats vector
+            # costs one small D2H, never a mid-pipeline sync)
+            per_shard = _count_exchange(
+                res.stats_padded, res.exchange_bytes,
+                registry=self._metrics,
+            )
+            routed = int(per_shard[:, 0].sum())
+            dropped = int(per_shard[:, 1].sum())
+            if self.exchange is not None:
+                self.exchange["routed_total"] += routed
+                self.exchange["dropped_total"] += dropped
+                self.exchange["exchange_bytes_total"] += (
+                    res.exchange_bytes or 0
+                )
+                for s, n in enumerate(per_shard[:, 2].tolist()):
+                    self.exchange["served_per_shard"][s] += int(n)
+            extra = {"routed": routed, "dropped": dropped}
+            # the per-shard load event is the hang-attribution record: a
+            # flight reader pairing an OPEN batch span with the LAST
+            # exchange event before it sees which shard was carrying the
+            # requests when serving stopped
+            obs_spans.event(
+                "exchange", cat="serve", seq=res.seq,
+                served_per_shard=per_shard[:, 2].tolist(),
+                routed=routed, dropped=dropped,
+            )
         # the dispatch→retire span closes with the same honest latency
         # the session reports; a beat per retire lets a supervisor see
         # serving progress (a wedged dispatch stops both immediately)
         obs_spans.end_span(
             sid, latency_s=res.latency_s, retries=res.retries,
-            deadline_breached=res.deadline_breached,
+            deadline_breached=res.deadline_breached, **extra,
         )
         maybe_beat(f"serve-batch-{res.seq}")
         self._metrics.counter(
@@ -779,18 +1023,24 @@ class ServeSession:
         bucket = bucket_rows(queries.shape[0], cfg.query_bucket)
         exec_ = get_executable(self.index, cfg, bucket)
         q2d, qids, rows = _prep_queries(self.index, cfg, exec_, queries)
-        d, i = _run(self.index, cfg, exec_, q2d, qids)
-        return bucket, rows, poison_topk(d), i
+        d, i, stats = _run(self.index, cfg, exec_, q2d, qids)
+        return bucket, rows, poison_topk(d), i, stats, exec_.exchange_bytes
 
     def submit(self, queries) -> list[BatchResult]:
         t0 = time.perf_counter()
         label, cfg = self.ladder[self._rung]
         # the batch span opens BEFORE the dispatch attempt: a hang inside
         # the dispatch leaves an OPEN "batch" record in the flight file —
-        # the kill diagnosis a supervisor banks (ISSUE 7)
+        # the kill diagnosis a supervisor banks (ISSUE 7). Sharded-
+        # clustered sessions stamp the shard topology on the span: an
+        # open span plus the last retired batch's per-shard exchange
+        # event is how a flight reader attributes a hang to a shard.
+        span_attrs = {}
+        if self.index.backend == "ivf-sharded":
+            span_attrs["shards"] = self.index.shards
         sid = obs_spans.begin_span(
             "batch", cat="serve", seq=self._seq,
-            rows=int(queries.shape[0]), rung=label,
+            rows=int(queries.shape[0]), rung=label, **span_attrs,
         )
         pol = self.policy
         try:
@@ -802,7 +1052,7 @@ class ServeSession:
                     max_s=pol.backoff_max_s,
                     retryable=pol.retryable,
                 )
-                bucket, rows, d, i = out.value
+                bucket, rows, d, i, stats, xbytes = out.value
                 retries, backoffs = out.attempts - 1, out.backoffs
                 self.retries_total += retries
                 if retries:
@@ -815,7 +1065,9 @@ class ServeSession:
                         help="transient dispatch failures retried",
                     ).inc(retries)
             else:
-                bucket, rows, d, i = self._dispatch(queries, cfg)
+                bucket, rows, d, i, stats, xbytes = self._dispatch(
+                    queries, cfg
+                )
                 retries, backoffs = 0, ()
         except Exception as e:
             # a RAISED dispatch failure (retries exhausted, non-retryable
@@ -829,6 +1081,8 @@ class ServeSession:
             degraded=None if label == FULL_RUNG else label,
             retries=retries,
             backoffs=backoffs,
+            stats_padded=stats,
+            exchange_bytes=xbytes,
         )
         self._seq += 1
         self._inflight.append((res, t0, sid))

@@ -352,6 +352,77 @@ def main(argv=None) -> int:
               f"median {row['median_s']}s  {row['queries_per_s']} q/s  "
               f"recall@{k} {row['recall_at_k']}", flush=True)
 
+    # -- SHARDED clustered path: routed candidate exchange over the mesh --
+    # The same trained index distributed over 2- and 4-device ring meshes
+    # (ivf/sharded.py) at nprobe ∈ {1, 4}, next to the single-device
+    # ivf_query rows above and the dense ring_allknn rows — one artifact
+    # answers "what does sharding the bucket store cost per query, and
+    # what recall does each probe count buy". On CPU the all-to-alls are
+    # memcpys (the ring-row rationale): the rows pin exchange-machinery
+    # overhead per PR, not ICI; each row carries the routed/dropped
+    # exchange story so a skewed routing table is visible in the artifact.
+    if args.ring_devices:
+        from mpi_knn_tpu.ivf import search_ivf_sharded, shard_ivf_index
+
+        for shards in (2, 4):
+            if shards > args.ring_devices:
+                # no silent caps: a "4-shard" row on a smaller mesh would
+                # measure a different layout under the bigger label
+                print(f"note: skipping ivf_sharded_query shards {shards} "
+                      f"> --ring-devices {args.ring_devices}",
+                      file=sys.stderr)
+                continue
+            sidx = shard_ivf_index(ivf_index, shards=shards)
+            for nprobe in (1, 4):
+                if nprobe > P:
+                    print(f"note: skipping ivf_sharded_query nprobe "
+                          f"{nprobe} > partitions {P}", file=sys.stderr)
+                    continue
+                got = search_ivf_sharded(
+                    sidx, Xi[sample], nprobe=nprobe
+                )[1]
+                recall = recall_at_k(got, oracle_ids)
+                session = ServeSession(sidx, nprobe=nprobe)
+                bucket = 128
+                n_batches = max(reps, 4)
+                batches = [Xi[(i * bucket) % max(1, c - bucket):][:bucket]
+                           for i in range(n_batches)]
+                session.warm([bucket])
+                session.submit(batches[0])
+                session.drain()
+                session.reset_stats()
+                t0 = time.perf_counter()
+                for b in batches:
+                    session.submit(b)
+                session.drain()
+                wall = time.perf_counter() - t0
+                lats = sorted(session.latencies)
+                row = {
+                    "op": "ivf_sharded_query",
+                    "variant": f"p{P}-s{shards}-nprobe{nprobe}",
+                    "median_s": round(statistics.median(lats), 6),
+                    "min_s": round(min(lats), 6),
+                    "reps_s": [round(t, 6) for t in lats],
+                    "p50_ms": round(
+                        float(np.percentile(lats, 50)) * 1e3, 3),
+                    "p99_ms": round(
+                        float(np.percentile(lats, 99)) * 1e3, 3),
+                    "queries_per_s": round(
+                        session.queries_served / wall, 1),
+                    "recall_at_k": round(float(recall), 4),
+                    "probe_fraction": round(nprobe / P, 4),
+                    "routed_total": session.exchange["routed_total"],
+                    "overflow_dropped_total":
+                        session.exchange["dropped_total"],
+                    "exchange_bytes_total":
+                        session.exchange["exchange_bytes_total"],
+                }
+                results.append(row)
+                print(f"{'ivf_sharded_query':16s} {row['variant']:20s} "
+                      f"median {row['median_s']}s  "
+                      f"{row['queries_per_s']} q/s  "
+                      f"recall@{k} {row['recall_at_k']}", flush=True)
+
     doc = {
         "schema": "bench_ops.v1",
         "platform": jax.default_backend(),

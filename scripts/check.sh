@@ -73,6 +73,20 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # budget; any finding fails the gate
 python -m mpi_knn_tpu lint -q --out artifacts/lint || fail=1
 
+note "sharded-IVF lint gate (ISSUE 8: routed candidate exchange)"
+# the sharded clustered cells by name (they also run inside the full
+# sweep above — the named pass exists so an exchange-accounting
+# regression is called out as such): the bucket store distributed over a
+# 4-shard CPU mesh, one-shot + serve × exact/mixed + the ladder-nprobe
+# rung, where R4 pins the program to exactly the four exchange
+# all-to-alls (full-ring replica groups, payload within the declared
+# per-tile budget — an unrouted full-bucket broadcast or an over-budget
+# per-shard gather is a finding) and R2-strict prices the probed-bytes
+# budget PER SHARD; the multi-shard recall-parity tests are tier-1 in
+# tests/test_ivf_sharded.py (the pytest gate below)
+python -m mpi_knn_tpu lint -q --backend ivf-sharded \
+    --out artifacts/lint_sharded || fail=1
+
 note "fault-injection / resilience suite (ISSUE 6 gate)"
 # the resilience layer's whole fault matrix, exercised on CPU rather than
 # trusted: injected hang → heartbeat-starvation kill with a structured

@@ -16,6 +16,28 @@ ROOT = Path(__file__).resolve().parent.parent
 MDIR = ROOT / "measurements"
 
 
+def normalize_failed(r: dict) -> dict:
+    """Normalize a pre-ISSUE-7 failed line IN THE PARSER, not at each
+    consumer: BENCH_r01/r03/r04/r05 banked watchdog kills as
+    ``{"value": 480.0, "vs_baseline": 0.0, "failed": true}`` — the kill
+    time stamped where a measurement belongs, plus a fake zero-regression
+    number. Folding a historical round must never let that shape reach a
+    perf table or aggregate, so the legacy row is rewritten to the
+    current contract (``value: null`` + explicit ``time_until_kill_s``,
+    no ``vs_baseline``) before anything downstream sees it."""
+    if (
+        isinstance(r, dict)
+        and r.get("failed")
+        and r.get("value") is not None
+        and "time_until_kill_s" not in r
+    ):
+        r = dict(r)
+        r["time_until_kill_s"] = r.pop("value")
+        r["value"] = None
+        r.pop("vs_baseline", None)
+    return r
+
+
 def rows(path):
     if not path.exists():
         return []
@@ -24,7 +46,7 @@ def rows(path):
         line = line.strip()
         if line:
             try:
-                out.append(json.loads(line))
+                out.append(normalize_failed(json.loads(line)))
             except json.JSONDecodeError:
                 out.append({"step": "?", "raw": line})
     return out

@@ -512,6 +512,53 @@ def test_fold_round_renders_round_rows(tmp_path, capsys, monkeypatch):
     assert "/device:CPU:0" not in out
 
 
+def test_fold_round_nulls_legacy_failed_lines(tmp_path, capsys, monkeypatch):
+    """Folding a HISTORICAL round must not count pre-ISSUE-7 watchdog
+    sentinels as measurements: BENCH_r01/r03/r04/r05 banked
+    ``"value": 480.0, "vs_baseline": 0.0, "failed": true`` — the kill
+    time where a measurement belongs plus a fake zero-regression number.
+    The parser now rewrites that legacy shape to the current contract
+    (``value: null`` + explicit ``time_until_kill_s``, ``vs_baseline``
+    dropped) before any consumer sees it. The fixture is the REAL r05
+    tail verbatim, non-JSON platform warning included."""
+    from scripts import fold_round
+
+    # the exact tail banked in BENCH_r05.json (and r01/r03/r04)
+    r05_tail = (
+        "WARNING:2026-07-30 20:56:02,633:jax._src.xla_bridge:905: "
+        "Platform 'axon' is experimental and not all JAX functionality "
+        "may be correctly supported!\n"
+        '{"metric": "mnist60k_allknn_k10_seconds", "value": 480.0, '
+        '"unit": "s", "vs_baseline": 0.0, "failed": true}\n'
+        '{"error": "watchdog: device unresponsive (wedged transport?); '
+        'no measurement completed"}\n'
+    )
+    monkeypatch.setattr(fold_round, "MDIR", tmp_path)
+    monkeypatch.setattr(sys, "argv", ["fold_round.py", "r5"])
+    (tmp_path / "r5.jsonl").write_text(r05_tail)
+
+    # the parser itself nulls the value and drops the fake vs_baseline
+    rows = fold_round.rows(tmp_path / "r5.jsonl")
+    legacy = [r for r in rows if r.get("failed")]
+    assert len(legacy) == 1
+    assert legacy[0]["value"] is None
+    assert legacy[0]["time_until_kill_s"] == 480.0
+    assert "vs_baseline" not in legacy[0]
+    # a line already in the current shape passes through untouched
+    current = fold_round.normalize_failed(
+        {"metric": "m", "value": None, "unit": "s", "failed": True,
+         "time_until_kill_s": 6.1}
+    )
+    assert current["value"] is None and current["time_until_kill_s"] == 6.1
+
+    assert fold_round.main() == 0
+    out = capsys.readouterr().out
+    # never a measurement row, always a status line with the kill time
+    assert "| mnist60k_allknn_k10_seconds |" not in out
+    assert "480.0 s" not in out.split("Step status")[0]
+    assert "WATCHDOG-FAILED at 480.0 s" in out
+
+
 def test_trace_ops_parses_real_ring_trace(tmp_path):
     """End-to-end on REAL trace bytes (VERDICT r4 weak #4): capture an
     actual ring-overlap run under ``jax.profiler.trace`` on the 8-device
