@@ -274,6 +274,28 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    if (
+        os.environ.get("BENCH_RING_XFER") == "int8"
+        and precision_policy != "mixed"
+    ):
+        # same refusal the config itself raises, surfaced as the bench's
+        # structured exit-2 so a sweep script reads WHY instead of a
+        # traceback: int8 transfer has no rerank to absorb the
+        # quantization under the exact policy — the run would silently
+        # degrade every banked distance, not just the preselect keys
+        print(
+            json.dumps({
+                "error": "BENCH_RING_XFER=int8 requires "
+                "BENCH_PRECISION_POLICY=mixed: the block-scaled int8 "
+                "transfer is dequantized into the compress dot and the "
+                "exact HIGHEST rerank absorbs the quantization noise — "
+                f"policy {precision_policy!r} has no rerank, so the "
+                "banked recall would silently carry full quantization "
+                "error"
+            }),
+            file=sys.stderr,
+        )
+        return 2
     if ivf_nprobe and not ivf_partitions:
         print(
             json.dumps({

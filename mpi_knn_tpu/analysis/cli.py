@@ -15,7 +15,11 @@ from mpi_knn_tpu.config import METRICS
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from mpi_knn_tpu.analysis.lowering import LINT_BACKENDS, LINT_DTYPES
+    from mpi_knn_tpu.analysis.lowering import (
+        LINT_BACKENDS,
+        LINT_DTYPES,
+        LINT_QUANTS,
+    )
 
     p = argparse.ArgumentParser(
         prog="mpi-knn lint",
@@ -43,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "per-batch programs the executable cache compiles, "
                    "whose donation/aliasing and no-corpus-copy contract "
                    "R5 certifies)")
+    p.add_argument("--quant", action="append", choices=list(LINT_QUANTS),
+                   help="restrict to quantized cells: xfer-int8 (the "
+                   "block-scaled int8 ring transfer — R3's quant/dequant "
+                   "contract, R4's wire-priced 3-permutes-per-direction "
+                   "accounting) or int8/int4 (the clustered store's "
+                   "at-rest levels — R2's wire-priced gather bound); "
+                   "repeatable")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -90,6 +101,7 @@ def main(argv=None) -> int:
         and (not args.dtype or t.dtype in args.dtype)
         and (not args.policy or t.policy in args.policy)
         and (not args.schedule or t.schedule in args.schedule)
+        and (not args.quant or t.quant in args.quant)
         and (t.serve or not args.serve)
     ]
     if not targets:

@@ -59,6 +59,7 @@ class TargetResult:
             "dtype": self.target.dtype,
             "policy": self.target.policy,
             "schedule": self.target.schedule,
+            "quant": self.target.quant,
             "serve": self.target.serve,
             "ok": self.ok,
             "skipped": self.skipped,

@@ -32,6 +32,13 @@ from mpi_knn_tpu.config import BACKENDS, METRICS, KNNConfig
 STAGES = ("before_opt", "after_opt")
 LINT_DTYPES = ("float32", "bfloat16", "float64")
 LINT_POLICIES = ("exact", "mixed")
+# the quantization axis (ISSUE 9): "" = unquantized; "xfer-int8" = the
+# int8 block-scaled RING TRANSFER (mixed-policy ring cells — config.py
+# refuses exact); "int8"/"int4" = the clustered store's block-scaled
+# AT-REST levels. Quantized cells run the quant/dequant dtype contract
+# (R3), wire-priced budgets (R2 gather bytes, R4 permute/all-to-all
+# payloads), and the usual donation/probe-discipline rules.
+LINT_QUANTS = ("xfer-int8", "int8", "int4")
 # the dense (full-scan) backends sweep the whole metric × dtype product;
 # the clustered "ivf" / "ivf-sharded" cells are appended explicitly
 # (l2/float32 only — the IVF path's own contract) but share the CLI
@@ -70,6 +77,7 @@ class LintTarget:
     schedule: str = "uni"
     serve: bool = False
     ladder: str = ""  # "" | "bucket" | "nprobe" — serve cells only
+    quant: str = ""  # "" | "xfer-int8" (ring) | "int8" | "int4" (at-rest)
 
     @property
     def label(self) -> str:
@@ -78,6 +86,8 @@ class LintTarget:
             base = f"{base}/{self.policy}"
         if self.schedule != "uni":
             base = f"{base}/{self.schedule}"
+        if self.quant:
+            base = f"{base}/{self.quant}"
         if self.serve:
             base = f"{base}/serve"
         if self.ladder:
@@ -170,6 +180,42 @@ def default_targets() -> list[LintTarget]:
         # finding), with R5's donation contract intact on degraded cells
         LintTarget("ivf-sharded", "l2", "float32", serve=True,
                    ladder="nprobe"),
+    ] + [
+        # the QUANTIZED cells (ISSUE 9). Ring transfer at int8 — mixed
+        # policy only (config.py refuses exact): R3 certifies the
+        # quant/dequant contract (exactly one dequant convert + scale
+        # multiply feeding each compress dot; no dot touches raw codes),
+        # R4 counts THREE permutes per direction (codes + scales + ids)
+        # and prices every permute payload at the wire dtype, R1
+        # re-certifies overlap/blocking sequencing with the scale row in
+        # the rotation (possible only because quantization happens at
+        # shard time, OUTSIDE the compiled rotation).
+        LintTarget("ring", "l2", "float32", "mixed", quant="xfer-int8"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed",
+                   quant="xfer-int8"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed", "bidir",
+                   quant="xfer-int8"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed", serve=True,
+                   quant="xfer-int8"),
+    ] + [
+        # clustered at-rest int8/int4: R2-strict keeps the element budget
+        # AND adds the wire-priced gather bound (the probe gather must
+        # move code lanes, 4–8× under the f32 bytes — dequantize AFTER
+        # the gather), R6's probe discipline re-certifies on the code
+        # gathers, R3 checks the dequant contract, and the serve cell
+        # re-certifies R5's donation on a quantized bucket-cache program.
+        LintTarget("ivf", "l2", "float32", quant="int8"),
+        LintTarget("ivf", "l2", "float32", "mixed", quant="int8"),
+        LintTarget("ivf", "l2", "float32", quant="int4"),
+        LintTarget("ivf", "l2", "float32", "mixed", serve=True,
+                   quant="int8"),
+        # sharded at-rest int8: the candidate returns ride the exchange
+        # as code lanes + a FIFTH (scales) all-to-all — R4 pins the count
+        # and holds the payload to the wire-priced budget; R2-strict's
+        # per-shard gather bound covers the owner-side exchange gather.
+        LintTarget("ivf-sharded", "l2", "float32", "mixed", quant="int8"),
+        LintTarget("ivf-sharded", "l2", "float32", "mixed", serve=True,
+                   quant="int8"),
     ]
 
 
@@ -190,6 +236,9 @@ def _base_cfg(target: LintTarget) -> KNNConfig:
         ),
         precision_policy=target.policy,
         ring_schedule=target.schedule,
+        ring_transfer_dtype=(
+            "int8" if target.quant == "xfer-int8" else None
+        ),
     )
 
 
@@ -288,10 +337,20 @@ def _lower_ring(target: LintTarget):
     q_axis, axis, dp, ring_n = parse_ring_mesh(mesh)
     q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, LINT_NQ, dp, ring_n)
     dtype = jnp.dtype(cfg.dtype)
+    quantized = target.quant == "xfer-int8"
+    corpus_args = (
+        # the quantized driver quantizes at shard time: the rotation
+        # program's corpus inputs ARE int8 codes + the per-row scales
+        dict(corpus=jnp.zeros((c_pad, LINT_D), jnp.int8),
+             corpus_scale=jnp.zeros((c_pad,), jnp.float32))
+        if quantized
+        else dict(corpus=jnp.zeros((c_pad, LINT_D), dtype),
+                  corpus_scale=None)
+    )
     lowered = _ring_knn_sharded.lower(
         jnp.zeros((q_pad, LINT_D), dtype),
         jnp.zeros((q_pad,), jnp.int32),
-        jnp.zeros((c_pad, LINT_D), dtype),
+        corpus_args["corpus"],
         jnp.zeros((c_pad,), jnp.int32),
         cfg,
         target.backend == "ring-overlap",
@@ -300,6 +359,7 @@ def _lower_ring(target: LintTarget):
         q_tile,
         c_tile,
         q_axis=q_axis,
+        corpus_scale=corpus_args["corpus_scale"],
     )
     meta = {
         "q_tile": q_tile,
@@ -307,13 +367,23 @@ def _lower_ring(target: LintTarget):
         "acc_bytes": _acc_bytes(target.dtype),
         "ring_n": ring_n,
         "ring_schedule": target.schedule,
-        # the corpus block and its global-id row rotate together; the bidir
-        # schedule doubles that: one (block, ids) pair per torus direction,
+        # the corpus block and its global-id row rotate together (a
+        # quantized block adds its scale row — three permutes per
+        # direction); the bidir schedule doubles that per torus direction,
         # with counter-directed source_target_pairs (R4 checks both the
         # count and the direction split)
-        "expected_permutes": 4 if target.schedule == "bidir" else 2,
+        "expected_permutes": (
+            (6 if target.schedule == "bidir" else 3) if quantized
+            else (4 if target.schedule == "bidir" else 2)
+        ),
         **_mixed_meta(target, q_tile, c_tile),
     }
+    if quantized:
+        meta["quantized"] = True
+        # wire pricing: the largest rotation payload is the int8 code
+        # block — (c_pad/ring_n rows × d) at 1 byte (ids/scales are d×
+        # smaller); a permute above this is rotating float-width rows
+        meta["permute_bytes_budget"] = (c_pad // ring_n) * LINT_D
     if target.schedule == "bidir":
         # R2: the second resident traveler is a REGISTERED intermediate —
         # two (c_pad/ring_n, d) blocks live per device instead of one. The
@@ -367,6 +437,22 @@ def _lower_pallas(target: LintTarget):
     return lowered, cfg, meta
 
 
+def serve_resident_bytes(index) -> int:
+    """R5's copy-census threshold for one resident index. For float
+    stores this is the resident payload itself. A QUANTIZED store is
+    4–8× smaller than the working set its own probe gather legitimately
+    materializes (each query row gathers its own copy of its probed
+    buckets — code-lane bytes, inside R2's wire-priced gather budget),
+    so the census prices quantized cells at the f32-EQUIVALENT store
+    bytes instead: "re-paying the corpus" means corpus-of-values-sized
+    copies, and the wire-width gather staying under the f32 store is
+    exactly the byte win the quantization bought."""
+    n = index.nbytes_resident
+    if getattr(index, "bucket_scales", None) is not None:
+        n = max(n, index.partitions * index.bucket_cap * index.dim * 4)
+    return n
+
+
 # IVF lint shapes: 256 deterministic rows over 8 partitions probed at 2 —
 # balanced buckets hold ~32 rows, so the probed width v = nprobe·cap ≥ 64
 # keeps the mixed overfetch 4k=16 strictly narrower than v (the R3/R6
@@ -383,6 +469,11 @@ def _ivf_cfg(target: LintTarget) -> KNNConfig:
         partitions=LINT_PARTITIONS,
         nprobe=LINT_NPROBE,
         kmeans_iters=2,  # lint cares about the search program, not fit
+        # the at-rest quantization axis rides cfg.dtype (the bf16-store
+        # convention): the lint index is genuinely quantized — codes,
+        # scales, dequantized norms — so the cells certify the real store
+        dtype=(target.quant if target.quant in ("int8", "int4")
+               else "float32"),
     )
 
 
@@ -399,7 +490,7 @@ def _ivf_lint_index(cfg: KNNConfig):
 
 def _ivf_meta(index, cfg: KNNConfig, q_tile: int) -> dict:
     v = cfg.nprobe * index.bucket_cap
-    return {
+    meta = {
         "q_tile": q_tile,
         "c_tile": v,
         "acc_bytes": 4,
@@ -410,6 +501,17 @@ def _ivf_meta(index, cfg: KNNConfig, q_tile: int) -> dict:
         # query row (the sublinear claim, machine-checked)
         "budget_elems": q_tile * v * index.dim,
     }
+    if index.bucket_scales is not None:
+        meta["quantized"] = True
+        # wire-priced gather bound: the probe gather moves CODE lanes
+        # ((q_tile, nprobe, cap, packed_dim) int8 — 2× headroom for the
+        # mixed path's survivor-row f32 gather, which is 4k/v of the
+        # probed width at 4 bytes); an f32-sized bucket gather means the
+        # store was dequantized before the gather
+        meta["quant_gather_bytes"] = (
+            2 * q_tile * v * index.buckets.shape[-1]
+        )
+    return meta
 
 
 def _lower_ivf(target: LintTarget):
@@ -439,6 +541,7 @@ def _lower_ivf(target: LintTarget):
         index.buckets,
         index.bucket_ids,
         index.bucket_sqs,
+        index.bucket_scales,
         cfg,
         cfg.nprobe,
     )
@@ -472,10 +575,13 @@ def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
     from mpi_knn_tpu.ivf.sharded import (
         exchange_bytes_per_tile,
         exchange_elems,
+        exchange_wire_args,
+        expected_exchange_alltoalls,
     )
 
     v = cfg.nprobe * index.bucket_cap
-    return {
+    wire_dim, wire_itemsize, wire_scale = exchange_wire_args(index)
+    meta = {
         "q_tile": q_tile,
         "c_tile": v,
         "acc_bytes": 4,
@@ -483,13 +589,15 @@ def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
         "dim": index.dim,
         "shards": index.shards,
         "route_cap": route_cap,
-        # R4: the candidate exchange is exactly these four all-to-alls
-        # (request table + rows/ids/norms returns), full-ring groups,
-        # payload bytes inside this declared per-tile budget
-        "expected_alltoalls": 4,
+        # R4: the candidate exchange is exactly these all-to-alls
+        # (request table + rows/ids/norms returns; a quantized store adds
+        # the scales return), full-ring groups, payload bytes inside this
+        # declared per-tile budget — priced at the WIRE width (a
+        # quantized store's rows are int8 code lanes)
+        "expected_alltoalls": expected_exchange_alltoalls(index),
         "exchange_bytes_tile": exchange_bytes_per_tile(
-            index.shards, route_cap, index.bucket_cap, index.dim,
-            index.buckets.dtype.itemsize,
+            index.shards, route_cap, index.bucket_cap, wire_dim,
+            wire_itemsize, wire_scale,
         ),
         # R2 STRICT, per shard: one resident tile's rerank working set or
         # its exchange buffers, whichever is larger — NOT the shard's
@@ -501,6 +609,17 @@ def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
             ),
         ),
     }
+    if index.bucket_scales is not None:
+        meta["quantized"] = True
+        # wire-priced gather bound, per shard: the larger of the home
+        # probe width and the owner-side exchange gather, in code-lane
+        # bytes (2× headroom for the survivor f32 gather of the mixed
+        # finish)
+        meta["quant_gather_bytes"] = 2 * max(
+            q_tile * v,
+            index.shards * route_cap * index.bucket_cap,
+        ) * index.buckets.shape[-1]
+    return meta
 
 
 def _require_sharded_mesh() -> None:
@@ -546,6 +665,7 @@ def _lower_ivf_sharded(target: LintTarget):
         index.buckets,
         index.bucket_ids,
         index.bucket_sqs,
+        index.bucket_scales,
         cfg,
         cfg.nprobe,
         index.mesh,
@@ -604,7 +724,7 @@ def _lower_serve(target: LintTarget):
             **_ivf_sharded_meta(index, cfg, q_tile, route_cap),
             "serve": True,
             "donated_params": SHARDED_SCRATCH_PARAMS if cfg.donate else (),
-            "resident_bytes": index.nbytes_resident,
+            "resident_bytes": serve_resident_bytes(index),
         }
         return lowered, cfg, meta
 
@@ -628,7 +748,7 @@ def _lower_serve(target: LintTarget):
             **_ivf_meta(index, cfg, q_tile),
             "serve": True,
             "donated_params": SCRATCH_PARAMS if cfg.donate else (),
-            "resident_bytes": index.nbytes_resident,
+            "resident_bytes": serve_resident_bytes(index),
         }
         return lowered, cfg, meta
 
@@ -660,16 +780,25 @@ def _lower_serve(target: LintTarget):
         # R5: the scratch params MUST carry the donation in the header,
         # and nothing in the batch program may copy the resident corpus
         "donated_params": SCRATCH_PARAMS if index.cfg.donate else (),
-        "resident_bytes": index.nbytes_resident,
+        "resident_bytes": serve_resident_bytes(index),
         **_mixed_meta(target, q_tile, index.c_tile),
     }
     if target.backend in RING_BACKENDS:
         ring_n = index.ring_meta[3]
+        quantized = target.quant == "xfer-int8"
         meta.update(
             ring_n=ring_n,
             ring_schedule=target.schedule,
-            expected_permutes=4 if target.schedule == "bidir" else 2,
+            expected_permutes=(
+                (6 if target.schedule == "bidir" else 3) if quantized
+                else (4 if target.schedule == "bidir" else 2)
+            ),
         )
+        if quantized:
+            meta["quantized"] = True
+            meta["permute_bytes_budget"] = (
+                index.corpus_sharded.shape[0] // ring_n * LINT_D
+            )
     return lowered, index.cfg, meta
 
 

@@ -22,7 +22,15 @@ Shipped rules:
 - **R3-dtype** — dtype integrity. In float64 debug mode no value may be
   silently downcast (f64→f32/bf16/f16 ``convert``); in any mode a ``dot``
   with bf16 operands must accumulate wider (bf16→bf16 dots lose the MXU's
-  f32 accumulator).
+  f32 accumulator). Quantized cells (int8 transfer / int8-int4 at-rest
+  stores, ``meta["quantized"]``) add the quant/dequant contract: no dot
+  may consume raw int8 codes (scoring codes without their scale is
+  numerically meaningless, not merely imprecise), the module must
+  contain at least one dequant (an s8→float ``convert`` — zero means the
+  quantized payload never reaches compute and every check here is
+  vacuous), and in mixed-policy cells each DEFAULT compress dot must be
+  fed by EXACTLY ONE dequant convert plus a scale ``multiply`` in its
+  backward slice.
 - **R4-collective** — collective accounting. Ring backends must contain
   exactly the expected corpus-rotation ``collective-permute``s with
   ring-shaped ``source_target_pairs`` and nothing else (uni: one block+ids
@@ -387,6 +395,15 @@ class R2Memory(Rule):
                 ctx.meta.get("extra_elems", 0),
             ) * acc_bytes
         exempt = self.STRICT_EXEMPT if strict is not None else ("parameter",)
+        # quantized stores additionally bound the GATHERS at the wire
+        # width: the probe/exchange gathers must move code lanes (+ the
+        # small scale/id/norm tables), never float-widened rows — an
+        # f32-sized bucket gather under a quantized config means the
+        # store was dequantized BEFORE the gather, silently re-paying the
+        # bytes the quantization exists to cut (recall cost with no byte
+        # win). The element-denominated budget above cannot see this: the
+        # element counts are identical, only the itemsize differs.
+        quant_gather = ctx.meta.get("quant_gather_bytes")
         out = []
         for c in module.computations.values():
             for i in c.instructions.values():
@@ -395,6 +412,26 @@ class R2Memory(Rule):
                 if strict is not None and self._is_spmd_annotation(i):
                     continue  # partitioner directives, not materialization
                 b = max_buffer_bytes(i.type_str)
+                if (
+                    quant_gather is not None
+                    and i.opcode == "gather"
+                    and b > quant_gather
+                ):
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} gathers {b} bytes > the "
+                            f"quantized wire budget {quant_gather} — a "
+                            "float-sized bucket gather under a quantized "
+                            "config moves the bytes the store compressed "
+                            "away (dequantize AFTER the gather, not "
+                            "before)",
+                            {"bytes": b, "budget": quant_gather,
+                             "type": i.type_str},
+                        )
+                    )
                 if b > budget:
                     why = (
                         f"(declared probed-bytes bound {strict} elems, "
@@ -506,6 +543,125 @@ class R3Dtype(Rule):
             and getattr(ctx.cfg, "precision_policy", "exact") == "mixed"
         ):
             out.extend(self._check_mixed_contract(ctx, stage, module))
+        if stage == "before_opt" and ctx.meta.get("quantized"):
+            out.extend(self._check_quant_contract(ctx, stage, module))
+        return out
+
+    @staticmethod
+    def _is_dequant_convert(comp, instr) -> bool:
+        """A ``convert`` whose source is int8 codes and whose result is a
+        float — the first half of the dequant pair."""
+        if instr.opcode != "convert":
+            return False
+        if _result_dtype(instr.type_str) not in ("f32", "bf16", "f16",
+                                                 "f64"):
+            return False
+        for o in instr.operands:
+            src = comp.instructions.get(o)
+            if src is not None and _result_dtype(src.type_str) == "s8":
+                return True
+        return False
+
+    def _check_quant_contract(self, ctx, stage, module) -> list[Finding]:
+        """The quantized dtype contract (before-opt — fusion may legally
+        rewrite the dequant afterwards; the declared dataflow is pinned on
+        the module XLA receives): quantized payload reaches compute ONLY
+        through the dequant (convert out of int8 + multiply by the scale).
+        A dot consuming raw s8 operands is scoring codes without their
+        scale; a quantized program with no s8→float convert at all never
+        dequantized (the codes are dead or — worse — reinterpreted), which
+        would make every other check here vacuous."""
+        out = []
+        n_dequant = 0
+        for c in module.computations.values():
+            for i in c.instructions.values():
+                if self._is_dequant_convert(c, i):
+                    n_dequant += 1
+                if i.opcode != "dot":
+                    continue
+                op_dts = [
+                    _result_dtype(c.instructions[o].type_str)
+                    for o in i.operands
+                    if o in c.instructions
+                ]
+                if any(dt in ("s8", "s4", "u8", "u4") for dt in op_dts):
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} is a dot consuming raw "
+                            f"int8/int4 codes ({op_dts}) — quantized "
+                            "payload must be dequantized (convert + scale "
+                            "multiply) before any distance dot; scoring "
+                            "codes without their block scale is not a "
+                            "precision loss, it is a different function",
+                            {"operand_dtypes": op_dts,
+                             "type": i.type_str},
+                        )
+                    )
+        if n_dequant == 0:
+            out.append(
+                Finding(
+                    self.name,
+                    ctx.target.label,
+                    stage,
+                    "quantized cell lowered NO s8→float dequant convert — "
+                    "the quantized payload never reaches compute through "
+                    "the dequant path (the quant contract is vacuous)",
+                    {},
+                )
+            )
+        if getattr(ctx.cfg, "precision_policy", "exact") != "mixed":
+            return out
+        # mixed quantized cells: the compress dot is where the quantized
+        # rows enter the pipeline — each DEFAULT dot must see exactly one
+        # dequant convert and the scale multiply in its backward slice (a
+        # second convert would mean two quantized sources merged into one
+        # compress pass the budgets do not model; zero means the compress
+        # pass is scoring something other than the dequantized store)
+        for c in module.computations.values():
+            for i in c.instructions.values():
+                if i.opcode != "dot" or dot_precision_class(i) != "default":
+                    continue
+                sl = backward_slice(module, c.name, i.name)
+                convs = 0
+                has_mul = False
+                for sc, sn in sl:
+                    si = module.instr(sc, sn)
+                    if si.opcode == "multiply":
+                        has_mul = True
+                    if self._is_dequant_convert(
+                        module.computations[sc], si
+                    ):
+                        convs += 1
+                if convs != 1:
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} (DEFAULT compress dot) "
+                            f"has {convs} dequant converts in its "
+                            "backward slice — the quantized contract is "
+                            "exactly one dequant feeding each compress "
+                            "dot",
+                            {"dequant_converts": convs},
+                        )
+                    )
+                elif not has_mul:
+                    out.append(
+                        Finding(
+                            self.name,
+                            ctx.target.label,
+                            stage,
+                            f"{c.name}::{i.name} (DEFAULT compress dot) "
+                            "sees the dequant convert but NO scale "
+                            "multiply in its backward slice — the codes "
+                            "are being scored unscaled",
+                            {},
+                        )
+                    )
         return out
 
     def _check_mixed_contract(self, ctx, stage, module) -> list[Finding]:
@@ -1057,6 +1213,30 @@ class R4Collectives(Rule):
                 )
         permutes = found.get(RING_COLLECTIVE, [])
         expected = ctx.meta.get("expected_permutes")
+        # wire-dtype pricing (quantized transfer cells): every rotation
+        # permute's payload must fit the block's WIRE bytes — int8 codes
+        # for the block, s32/f32 for the small id/scale rows. A permute
+        # moving 4× the budget is rotating float rows under an int8
+        # label: the recall cost of quantization with none of the byte
+        # win. Before-opt only (the combiner may later legally fuse the
+        # three permutes into one tuple-typed collective).
+        pbudget = ctx.meta.get("permute_bytes_budget")
+        if stage == "before_opt" and pbudget is not None:
+            for comp, name in permutes:
+                b = max_buffer_bytes(module.instr(comp, name).type_str)
+                if b > pbudget:
+                    out.append(
+                        Finding(
+                            self.name,
+                            t.label,
+                            stage,
+                            f"{comp}::{name} moves {b} bytes > the "
+                            f"wire-dtype budget {pbudget} (the int8 code "
+                            "block) — the rotation is shipping wider "
+                            "payload than the declared transfer dtype",
+                            {"bytes": b, "budget": pbudget},
+                        )
+                    )
         if stage == "before_opt" and expected is not None:
             sched = ctx.meta.get("ring_schedule", "uni")
             if len(permutes) != expected:

@@ -72,6 +72,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import sq_norms
+from mpi_knn_tpu.ops.quant import (
+    dequantize_rows,
+    quantize_rows,
+    row_wire_bytes,
+)
 from mpi_knn_tpu.ops.topk import init_topk
 from mpi_knn_tpu.backends.serial import (
     cap_corpus_tile,
@@ -118,7 +123,8 @@ def blocking_undefined_on_mesh_error(mesh_axes) -> ValueError:
 def _ring_knn_local(
     queries: jax.Array,  # (q_local, d) this device's query rows
     query_ids: jax.Array,  # (q_local,)
-    block: jax.Array,  # (b, d) this device's corpus shard
+    block: jax.Array,  # (b, d) this device's corpus shard (int8 codes
+    # when cfg.ring_transfer_dtype == "int8" — quantized at shard time)
     block_ids: jax.Array,  # (b,)
     cfg: KNNConfig,
     overlap: bool,
@@ -131,8 +137,10 @@ def _ring_knn_local(
     rotate: bool = True,  # single-round only: skip the ppermute on the last
     # round (the scan path gets this for free via dead-code elimination; a
     # live jit output would actually pay the ICI transfer)
+    block_scale=None,  # (b,) f32 per-row scales of an int8-quantized block
     block_bwd=None,  # bidir single-round only: the backward traveler
     block_bwd_ids=None,
+    block_bwd_scale=None,  # bidir int8 single-round only
     merge_bwd: bool = False,  # bidir single-round only: merge the backward
     # traveler too (False on the degenerate rounds — r=0 and, for even P,
     # the antipodal round)
@@ -147,12 +155,24 @@ def _ring_knn_local(
     ``cfg.ring_schedule="bidir"`` adds a second resident block (the
     backward traveler) — still O(b·d), now ×2.
 
+    ``cfg.ring_transfer_dtype="int8"`` blocks arrive PRE-QUANTIZED (the
+    host wrappers run ``ops.quant.quantize_rows`` once at shard time —
+    quantizing in here would re-pay the reduction per serve batch and, in
+    the overlap schedule, hang it off the permutes' backward slice) with
+    their per-row scale vector riding alongside: every schedule permutes
+    (codes, scales, ids) together — R4 counts 3 permutes per direction —
+    and each round dequantizes codes·scale directly into the compress dot
+    (the convert/multiply pair lint rule R3 demands). The exact HIGHEST
+    rerank finish of the mixed pipeline is untouched; it just reranks the
+    dequantized rows, which is what the recall gate measures.
+
     With ``single_round=True`` (the resumable driver,
     backends.ring_resumable) exactly one round runs and the rotated block(s)
     are returned alongside the merged carry, so the host owns the round
     cursor."""
     num_dev = axis_size(axis)
     bidir = cfg.ring_schedule == "bidir"
+    quantized = cfg.ring_transfer_dtype == "int8"
     # send to the next rank, wrap at the end — the reference's ring direction
     # (rank -> rank+1, mpi-knn-parallel_blocking.c:131); bidir adds the
     # counter-rotating permute so both ICI link directions carry a block
@@ -168,7 +188,14 @@ def _ring_knn_local(
         # rather than mislabel — tests/test_mesh2d.py asserts this.
         raise blocking_undefined_on_mesh_error(vary_axes)
 
-    if cfg.ring_transfer_dtype is not None:
+    if quantized:
+        if block.dtype != jnp.int8 or block_scale is None:
+            raise ValueError(
+                "int8 ring transfer expects the block pre-quantized at "
+                "shard time (int8 codes + the per-row scale vector) — the "
+                "host wrappers quantize once via ops.quant.quantize_rows"
+            )
+    elif cfg.ring_transfer_dtype is not None:
         # circulate the block at the transfer dtype (bf16 halves the bytes
         # every ppermute moves over ICI); cast ONCE here — rounding does not
         # compound per hop — and upcast per round inside compute()
@@ -179,6 +206,11 @@ def _ring_knn_local(
     q_local, dim = queries.shape
     b = block.shape[0]
     acc = jnp.float64 if queries.dtype == jnp.float64 else jnp.float32
+
+    def _rot(x, p):
+        """ppermute one traveler part; scale slots are None when the
+        transfer is not quantized (None = empty pytree, nothing moves)."""
+        return None if x is None else jax.lax.ppermute(x, axis, p)
 
     q_tiles = queries.reshape(q_local // q_tile, q_tile, dim)
     qid_tiles = query_ids.reshape(q_local // q_tile, q_tile)
@@ -198,8 +230,15 @@ def _ring_knn_local(
         carry_d = pcast_varying(carry_d, vary)
         carry_i = pcast_varying(carry_i, vary)
 
-    def compute(blk, blk_ids, cd, ci):
+    def compute(blk, blk_ids, blk_scl, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
+        if blk_scl is not None:
+            # the int8 dequant: ONE convert out of the code domain and ONE
+            # multiply by the block's scale vector, feeding every distance
+            # dot of the round (the contract lint rule R3 checks); norms
+            # below are recomputed from the dequantized rows, so distances
+            # are exact w.r.t. the quantized values
+            blk = dequantize_rows(blk, blk_scl, "int8", dim)
         blk = blk.astype(queries.dtype)  # no-op unless ring_transfer_dtype
         blk_tiles = blk.reshape(b // c_tile, c_tile, dim)
         blk_id_tiles = blk_ids.reshape(b // c_tile, c_tile)
@@ -223,13 +262,15 @@ def _ring_knn_local(
         return jax.lax.map(per_query_tile, (q_tiles, qid_tiles, cd, ci))
 
     def step(state, _):
-        blk, blk_ids, cd, ci = state
+        blk, scl, blk_ids, cd, ci = state
         if overlap:
             # permute and compute both depend only on the incoming block —
-            # XLA overlaps the ICI transfer with the distance matmul
+            # XLA overlaps the ICI transfer with the distance matmul (the
+            # quantized scale vector rides the same schedule)
             nxt = jax.lax.ppermute(blk, axis, perm)
+            nscl = _rot(scl, perm)
             nxt_ids = jax.lax.ppermute(blk_ids, axis, perm)
-            cd, ci = compute(blk, blk_ids, cd, ci)
+            cd, ci = compute(blk, blk_ids, scl, cd, ci)
         else:
             # blocking parity: the collective is sequenced *after* the compute
             # via an explicit barrier, modelling the reference's
@@ -241,13 +282,14 @@ def _ring_knn_local(
             # which found exactly that bug in the pre-r5 code). On a
             # multi-axis mesh this threading is type-impossible (the raise
             # above), so reaching here means the 1-D ring.
-            cd, ci = compute(blk, blk_ids, cd, ci)
-            blk, blk_ids, cd, ci = jax.lax.optimization_barrier(
-                (blk, blk_ids, cd, ci)
+            cd, ci = compute(blk, blk_ids, scl, cd, ci)
+            blk, scl, blk_ids, cd, ci = jax.lax.optimization_barrier(
+                (blk, scl, blk_ids, cd, ci)
             )
             nxt = jax.lax.ppermute(blk, axis, perm)
+            nscl = _rot(scl, perm)
             nxt_ids = jax.lax.ppermute(blk_ids, axis, perm)
-        return (nxt, nxt_ids, cd, ci), None
+        return (nxt, nscl, nxt_ids, cd, ci), None
 
     rounds, bwd_limit = bidir_rounds(num_dev)
 
@@ -259,11 +301,11 @@ def _ring_knn_local(
         masked two). Both permutes are issued every round — the pipeline
         must keep both travelers moving even when one of them is not merged
         this round."""
-        fblk, fids, bblk, bids, cd, ci = state
+        fblk, fscl, fids, bblk, bscl, bids, cd, ci = state
         do_bwd = jnp.logical_and(r >= 1, r < bwd_limit)
 
         def merge_bwd_traveler(cd, ci):
-            return compute(bblk, bids, cd, ci)
+            return compute(bblk, bids, bscl, cd, ci)
 
         def skip(cd, ci):
             return cd, ci
@@ -273,27 +315,33 @@ def _ring_knn_local(
             # backward merge is round-dependent, so the heavy per-tile
             # reduction is traced once per branch role, not duplicated
             # across both cond branches
-            cd, ci = compute(fblk, fids, cd, ci)
+            cd, ci = compute(fblk, fids, fscl, cd, ci)
             return jax.lax.cond(do_bwd, merge_bwd_traveler, skip, cd, ci)
 
         if overlap:
-            # all four permutes depend only on the incoming blocks; the two
+            # all permutes depend only on the incoming blocks; the two
             # directions ride the two halves of each full-duplex ICI link
             nfb = jax.lax.ppermute(fblk, axis, perm)
+            nfs = _rot(fscl, perm)
             nfi = jax.lax.ppermute(fids, axis, perm)
             nbb = jax.lax.ppermute(bblk, axis, perm_bwd)
+            nbs = _rot(bscl, perm_bwd)
             nbi = jax.lax.ppermute(bids, axis, perm_bwd)
             cd, ci = merge(cd, ci)
         else:
             cd, ci = merge(cd, ci)
-            fblk, fids, bblk, bids, cd, ci = jax.lax.optimization_barrier(
-                (fblk, fids, bblk, bids, cd, ci)
+            (fblk, fscl, fids, bblk, bscl, bids, cd, ci) = (
+                jax.lax.optimization_barrier(
+                    (fblk, fscl, fids, bblk, bscl, bids, cd, ci)
+                )
             )
             nfb = jax.lax.ppermute(fblk, axis, perm)
+            nfs = _rot(fscl, perm)
             nfi = jax.lax.ppermute(fids, axis, perm)
             nbb = jax.lax.ppermute(bblk, axis, perm_bwd)
+            nbs = _rot(bscl, perm_bwd)
             nbi = jax.lax.ppermute(bids, axis, perm_bwd)
-        return (nfb, nfi, nbb, nbi, cd, ci), None
+        return (nfb, nfs, nfi, nbb, nbs, nbi, cd, ci), None
 
     if single_round:
         if bidir:
@@ -302,43 +350,59 @@ def _ring_knn_local(
                     "bidir single-round needs the backward traveler "
                     "(block_bwd/block_bwd_ids)"
                 )
-            carry_d, carry_i = compute(block, block_ids, carry_d, carry_i)
+            if quantized and block_bwd_scale is None:
+                raise ValueError(
+                    "bidir int8 single-round needs the backward traveler's "
+                    "scale vector (block_bwd_scale)"
+                )
+            carry_d, carry_i = compute(
+                block, block_ids, block_scale, carry_d, carry_i
+            )
             if merge_bwd:
                 carry_d, carry_i = compute(
-                    block_bwd, block_bwd_ids, carry_d, carry_i
+                    block_bwd, block_bwd_ids, block_bwd_scale,
+                    carry_d, carry_i,
                 )
             if rotate:
                 if not overlap:
-                    (block, block_ids, block_bwd, block_bwd_ids,
+                    (block, block_scale, block_ids, block_bwd,
+                     block_bwd_scale, block_bwd_ids,
                      carry_d, carry_i) = jax.lax.optimization_barrier(
-                        (block, block_ids, block_bwd, block_bwd_ids,
+                        (block, block_scale, block_ids, block_bwd,
+                         block_bwd_scale, block_bwd_ids,
                          carry_d, carry_i)
                     )
                 nfb = jax.lax.ppermute(block, axis, perm)
+                nfs = _rot(block_scale, perm)
                 nfi = jax.lax.ppermute(block_ids, axis, perm)
                 nbb = jax.lax.ppermute(block_bwd, axis, perm_bwd)
+                nbs = _rot(block_bwd_scale, perm_bwd)
                 nbi = jax.lax.ppermute(block_bwd_ids, axis, perm_bwd)
             else:
-                nfb, nfi = block, block_ids
-                nbb, nbi = block_bwd, block_bwd_ids
-            return (
-                nfb, nfi, nbb, nbi,
-                carry_d.reshape(q_local, cfg.k),
-                carry_i.reshape(q_local, cfg.k),
-            )
+                nfb, nfs, nfi = block, block_scale, block_ids
+                nbb, nbs, nbi = block_bwd, block_bwd_scale, block_bwd_ids
+            out_d = carry_d.reshape(q_local, cfg.k)
+            out_i = carry_i.reshape(q_local, cfg.k)
+            if quantized:
+                # the rotated scale vectors are live state the resumable
+                # driver must thread to the next round (arity differs from
+                # the float path; the drivers branch on the static cfg)
+                return nfb, nfs, nfi, nbb, nbs, nbi, out_d, out_i
+            return nfb, nfi, nbb, nbi, out_d, out_i
         if rotate:
-            (nxt, nxt_ids, carry_d, carry_i), _ = step(
-                (block, block_ids, carry_d, carry_i), None
+            (nxt, nscl, nxt_ids, carry_d, carry_i), _ = step(
+                (block, block_scale, block_ids, carry_d, carry_i), None
             )
         else:
-            carry_d, carry_i = compute(block, block_ids, carry_d, carry_i)
-            nxt, nxt_ids = block, block_ids
-        return (
-            nxt,
-            nxt_ids,
-            carry_d.reshape(q_local, cfg.k),
-            carry_i.reshape(q_local, cfg.k),
-        )
+            carry_d, carry_i = compute(
+                block, block_ids, block_scale, carry_d, carry_i
+            )
+            nxt, nscl, nxt_ids = block, block_scale, block_ids
+        out_d = carry_d.reshape(q_local, cfg.k)
+        out_i = carry_i.reshape(q_local, cfg.k)
+        if quantized:
+            return nxt, nscl, nxt_ids, out_d, out_i
+        return nxt, nxt_ids, out_d, out_i
 
     if bidir:
         # ⌊P/2⌋+1 steps, both travelers starting as the own block. The last
@@ -346,9 +410,10 @@ def _ring_knn_local(
         # round index rides as the scan xs so the degenerate-round cond is
         # part of the one compiled step body (the HLO scan trip count IS
         # the round count — machine-checked in tests/test_hlo_overlap.py).
-        (_, _, _, _, carry_d, carry_i), _ = jax.lax.scan(
+        (_, _, _, _, _, _, carry_d, carry_i), _ = jax.lax.scan(
             bidir_step,
-            (block, block_ids, block, block_ids, carry_d, carry_i),
+            (block, block_scale, block_ids,
+             block, block_scale, block_ids, carry_d, carry_i),
             jnp.arange(rounds),
         )
         return carry_d.reshape(q_local, cfg.k), carry_i.reshape(q_local, cfg.k)
@@ -356,8 +421,9 @@ def _ring_knn_local(
     # P steps: own block once, then each of the P-1 received blocks — the
     # correct rotation the reference missed (SURVEY.md Q1). The final
     # permute's output is unused; XLA dead-code-eliminates it.
-    (_, _, carry_d, carry_i), _ = jax.lax.scan(
-        step, (block, block_ids, carry_d, carry_i), None, length=num_dev
+    (_, _, _, carry_d, carry_i), _ = jax.lax.scan(
+        step, (block, block_scale, block_ids, carry_d, carry_i),
+        None, length=num_dev
     )
     return carry_d.reshape(q_local, cfg.k), carry_i.reshape(q_local, cfg.k)
 
@@ -404,6 +470,40 @@ def _query_spec(q_axis, axis):
     return P((q_axis, axis)) if q_axis else P(axis)
 
 
+def ring_wire_bytes_per_batch(
+    cfg: KNNConfig, c_pad: int, dim: int, ring_n: int
+) -> int:
+    """Bytes ONE full rotation moves over the interconnect, summed over all
+    devices — static per (config, corpus layout), priced at the WIRE dtype
+    (f32/bf16 rows, or int8 codes + the f32 scale vector) plus the s32 id
+    row that always rides along. This is the number the serving engine
+    stamps into the ``ring_transfer_wire_bytes`` gauge at lower time (no
+    device reads), so the bf16/int8 byte cuts are visible in
+    ``mpi-knn metrics`` next to the recall they paid."""
+    b = c_pad // ring_n
+    itemsize = jnp.dtype(cfg.ring_transfer_dtype or cfg.dtype).itemsize \
+        if cfg.ring_transfer_dtype != "int8" else 4
+    block_bytes = b * row_wire_bytes(
+        dim, cfg.ring_transfer_dtype if cfg.ring_transfer_dtype == "int8"
+        else None, itemsize,
+    ) + b * 4  # the global-id row
+    if cfg.ring_schedule == "bidir":
+        rounds, _ = bidir_rounds(ring_n)
+        hops = 2 * (rounds - 1) * ring_n  # both travelers, last round DCE'd
+    else:
+        hops = (ring_n - 1) * ring_n
+    return hops * block_bytes
+
+
+def quantize_ring_block(corpus_p: jax.Array):
+    """The shard-time int8 quantization of a padded corpus: (c_pad, d)
+    float rows → ((c_pad, d) int8 codes, (c_pad,) f32 scales). One place —
+    the one-shot driver, the resumable driver and the serve index build
+    must produce bit-identical codes or a resumed/served run would diverge
+    from a fresh one."""
+    return quantize_rows(corpus_p, "int8")
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -422,11 +522,15 @@ def _ring_knn_sharded(
     q_tile,
     c_tile,
     q_axis=None,
+    corpus_scale=None,
 ):
     """Shard-mapped ring. On a 1-D mesh queries and corpus share the ring
     axis (the reference's layout). On a 2-D (dp × ring) mesh queries shard
     over `q_axis` (data parallel) while the corpus rings over `axis` — each
-    dp group runs an independent ring over its replica of the corpus."""
+    dp group runs an independent ring over its replica of the corpus.
+    ``corpus_scale`` is the per-row scale vector of an int8-quantized
+    corpus (``ring_transfer_dtype="int8"``; quantized at shard time by the
+    host wrapper), sharded like the corpus."""
     body = functools.partial(
         _ring_knn_local,
         cfg=cfg,
@@ -438,13 +542,25 @@ def _ring_knn_sharded(
     )
     qspec = _query_spec(q_axis, axis)
     cspec = P(axis)
+    if corpus_scale is None:
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qspec, qspec, cspec, cspec),
+            out_specs=(qspec, qspec),
+        )
+        return fn(queries, query_ids, corpus, corpus_ids)
+
+    def with_scale(q, qi, c, cids, cscl):
+        return body(q, qi, c, cids, block_scale=cscl)
+
     fn = shard_map(
-        body,
+        with_scale,
         mesh=mesh,
-        in_specs=(qspec, qspec, cspec, cspec),
+        in_specs=(qspec, qspec, cspec, cspec, cspec),
         out_specs=(qspec, qspec),
     )
-    return fn(queries, query_ids, corpus, corpus_ids)
+    return fn(queries, query_ids, corpus, corpus_ids, corpus_scale)
 
 
 def ring_serve_sharded(
@@ -454,6 +570,7 @@ def ring_serve_sharded(
     carry_i,
     corpus,
     corpus_ids,
+    corpus_scale,  # (c_pad,) f32 scales of an int8 index, else None
     cfg,
     overlap,
     mesh,
@@ -480,18 +597,34 @@ def ring_serve_sharded(
         vary_axes=tuple(mesh.axis_names),
     )
 
-    def with_carry(q, qi, cd, ci, c, cids):
-        return body(q, qi, c, cids, carry_in=(cd, ci))
-
     qspec = _query_spec(q_axis, axis)
     cspec = P(axis)
+    if corpus_scale is None:
+
+        def with_carry(q, qi, cd, ci, c, cids):
+            return body(q, qi, c, cids, carry_in=(cd, ci))
+
+        fn = shard_map(
+            with_carry,
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec, qspec, cspec, cspec),
+            out_specs=(qspec, qspec),
+        )
+        return fn(queries, query_ids, carry_d, carry_i, corpus, corpus_ids)
+
+    def with_carry_scale(q, qi, cd, ci, c, cids, cscl):
+        return body(q, qi, c, cids, carry_in=(cd, ci), block_scale=cscl)
+
     fn = shard_map(
-        with_carry,
+        with_carry_scale,
         mesh=mesh,
-        in_specs=(qspec, qspec, qspec, qspec, cspec, cspec),
+        in_specs=(qspec, qspec, qspec, qspec, cspec, cspec, cspec),
         out_specs=(qspec, qspec),
     )
-    return fn(queries, query_ids, carry_d, carry_i, corpus, corpus_ids)
+    return fn(
+        queries, query_ids, carry_d, carry_i, corpus, corpus_ids,
+        corpus_scale,
+    )
 
 
 def all_knn_ring(
@@ -526,6 +659,13 @@ def all_knn_ring(
     q_tile, c_tile, q_pad, c_pad = ring_tiles(cfg, m, nq, dp, ring_n)
 
     corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
+    corpus_scale = None
+    if cfg.ring_transfer_dtype == "int8":
+        # quantize ONCE at shard time (the EQuARX recipe): the rotation
+        # program receives (codes, scales) as inputs and only ever
+        # dequantizes — the quantization reduce never enters the compiled
+        # ring, so the overlap schedule's permutes stay compute-independent
+        corpus_p, corpus_scale = quantize_ring_block(corpus_p)
     corpus_ids = jnp.asarray(make_global_ids(m, c_pad))
     queries_p = pad_rows_any(queries, q_pad, dtype=dtype)
     qids_p = pad_rows_any(query_ids, q_pad, fill=-1, dtype=jnp.int32)
@@ -534,6 +674,8 @@ def all_knn_ring(
     q_sharding = NamedSharding(mesh, _query_spec(q_axis, axis))
     corpus_p = jax.device_put(corpus_p, c_sharding)
     corpus_ids = jax.device_put(corpus_ids, c_sharding)
+    if corpus_scale is not None:
+        corpus_scale = jax.device_put(corpus_scale, c_sharding)
     queries_p = jax.device_put(queries_p, q_sharding)
     qids_p = jax.device_put(qids_p, q_sharding)
 
@@ -549,5 +691,6 @@ def all_knn_ring(
         q_tile,
         c_tile,
         q_axis=q_axis,
+        corpus_scale=corpus_scale,
     )
     return best_d[:nq], best_i[:nq]

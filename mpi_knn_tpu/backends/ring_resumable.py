@@ -47,6 +47,7 @@ from mpi_knn_tpu.backends.ring import (
     bidir_rounds,
     blocking_undefined_on_mesh_error,
     parse_ring_mesh,
+    quantize_ring_block,
     ring_tiles,
 )
 from mpi_knn_tpu.ops.topk import init_topk
@@ -89,6 +90,7 @@ def _ring_one_round(
     c_tile,
     q_axis=None,
     rotate=True,
+    block_scale=None,
 ):
     """One ring round: merge the currently-held block into the carry and
     rotate the block one hop. Same schedule semantics as the scan step in
@@ -96,9 +98,41 @@ def _ring_one_round(
     matmul; False sequences compute before the send). The host passes
     ``rotate=False`` on the final round: in the scan path the last permute
     is dead code XLA eliminates, but here the block is a live jit output and
-    would pay a real ICI transfer for nothing."""
+    would pay a real ICI transfer for nothing.
 
-    def body(q, qid, blk, bids, cd, ci):
+    Under ``cfg.ring_transfer_dtype="int8"`` the block is int8 codes and
+    ``block_scale`` its per-row scale vector (quantized once by the driver
+    before the round loop); the rotated scales are returned alongside the
+    rotated codes — (nxt, nxt_scale, nxt_ids, carry_d, carry_i)."""
+    quantized = cfg.ring_transfer_dtype == "int8"
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
+    if not quantized:
+
+        def body(q, qid, blk, bids, cd, ci):
+            one = functools.partial(
+                _ring_knn_local,
+                cfg=cfg,
+                overlap=overlap,
+                axis=axis,
+                q_tile=q_tile,
+                c_tile=c_tile,
+                vary_axes=tuple(mesh.axis_names),
+                single_round=True,
+                carry_in=(cd, ci),
+                rotate=rotate,
+            )
+            return one(q, qid, blk, bids)
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qspec, qspec, cspec, cspec, qspec, qspec),
+            out_specs=(cspec, cspec, qspec, qspec),
+        )
+        return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
+
+    def body_q(q, qid, blk, bscl, bids, cd, ci):
         one = functools.partial(
             _ring_knn_local,
             cfg=cfg,
@@ -111,17 +145,17 @@ def _ring_one_round(
             carry_in=(cd, ci),
             rotate=rotate,
         )
-        return one(q, qid, blk, bids)
+        return one(q, qid, blk, bids, block_scale=bscl)
 
-    qspec = _query_spec(q_axis, axis)
-    cspec = P(axis)
     fn = shard_map(
-        body,
+        body_q,
         mesh=mesh,
-        in_specs=(qspec, qspec, cspec, cspec, qspec, qspec),
-        out_specs=(cspec, cspec, qspec, qspec),
+        in_specs=(qspec, qspec, cspec, cspec, cspec, qspec, qspec),
+        out_specs=(cspec, cspec, cspec, qspec, qspec),
     )
-    return fn(queries, query_ids, block, block_ids, carry_d, carry_i)
+    return fn(
+        queries, query_ids, block, block_scale, block_ids, carry_d, carry_i
+    )
 
 
 @functools.partial(
@@ -149,6 +183,8 @@ def _ring_one_round_bidir(
     q_axis=None,
     rotate=True,
     merge_bwd=False,
+    fblock_scale=None,
+    bblock_scale=None,
 ):
     """One bidirectional ring round: merge the forward traveler (block
     i−r), merge the backward traveler (block i+r) unless the round is
@@ -156,9 +192,42 @@ def _ring_one_round_bidir(
     even P), then rotate both travelers one hop in opposite directions.
     ``merge_bwd`` is static — the host knows the round plan, so the
     degenerate rounds compile to genuinely single-merge programs rather
-    than masked double merges."""
+    than masked double merges. Int8 transfer threads both travelers'
+    scale vectors and returns them rotated (8-tuple instead of 6)."""
+    quantized = cfg.ring_transfer_dtype == "int8"
+    qspec = _query_spec(q_axis, axis)
+    cspec = P(axis)
+    if not quantized:
 
-    def body(q, qid, fb, fids, bb, bids, cd, ci):
+        def body(q, qid, fb, fids, bb, bids, cd, ci):
+            one = functools.partial(
+                _ring_knn_local,
+                cfg=cfg,
+                overlap=overlap,
+                axis=axis,
+                q_tile=q_tile,
+                c_tile=c_tile,
+                vary_axes=tuple(mesh.axis_names),
+                single_round=True,
+                carry_in=(cd, ci),
+                rotate=rotate,
+                merge_bwd=merge_bwd,
+            )
+            return one(q, qid, fb, fids, block_bwd=bb, block_bwd_ids=bids)
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qspec, qspec, cspec, cspec, cspec, cspec, qspec,
+                      qspec),
+            out_specs=(cspec, cspec, cspec, cspec, qspec, qspec),
+        )
+        return fn(
+            queries, query_ids, fblock, fblock_ids, bblock, bblock_ids,
+            carry_d, carry_i,
+        )
+
+    def body_q(q, qid, fb, fscl, fids, bb, bscl, bids, cd, ci):
         one = functools.partial(
             _ring_knn_local,
             cfg=cfg,
@@ -172,19 +241,21 @@ def _ring_one_round_bidir(
             rotate=rotate,
             merge_bwd=merge_bwd,
         )
-        return one(q, qid, fb, fids, block_bwd=bb, block_bwd_ids=bids)
+        return one(
+            q, qid, fb, fids, block_scale=fscl, block_bwd=bb,
+            block_bwd_ids=bids, block_bwd_scale=bscl,
+        )
 
-    qspec = _query_spec(q_axis, axis)
-    cspec = P(axis)
     fn = shard_map(
-        body,
+        body_q,
         mesh=mesh,
-        in_specs=(qspec, qspec, cspec, cspec, cspec, cspec, qspec, qspec),
-        out_specs=(cspec, cspec, cspec, cspec, qspec, qspec),
+        in_specs=(qspec, qspec, cspec, cspec, cspec, cspec, cspec, cspec,
+                  qspec, qspec),
+        out_specs=(cspec, cspec, cspec, cspec, cspec, cspec, qspec, qspec),
     )
     return fn(
-        queries, query_ids, fblock, fblock_ids, bblock, bblock_ids,
-        carry_d, carry_i,
+        queries, query_ids, fblock, fblock_scale, fblock_ids,
+        bblock, bblock_scale, bblock_ids, carry_d, carry_i,
     )
 
 
@@ -339,7 +410,22 @@ def all_knn_ring_resumable(
 
     c_sharding = NamedSharding(mesh, P(axis))
     q_sharding = NamedSharding(mesh, _query_spec(q_axis, axis))
-    if cfg.ring_transfer_dtype is not None:
+    corpus_scale = bwd_scale = None
+    if cfg.ring_transfer_dtype == "int8":
+        # quantize BEFORE the round loop (the shard-time contract of
+        # backends.ring): per-row quantization commutes with the resume
+        # roll, and the codes are a deterministic function of the f32
+        # corpus — so a resumed run reconstructs bit-identical travelers
+        # by re-rolling and re-quantizing, with the one-integer checkpoint
+        # cursor unchanged. The scale vectors thread through every round
+        # alongside the codes.
+        corpus_p, corpus_scale = quantize_ring_block(corpus_p)
+        if bidir:
+            if shift:
+                bwd_p, bwd_scale = quantize_ring_block(bwd_p)
+            else:
+                bwd_p, bwd_scale = corpus_p, corpus_scale
+    elif cfg.ring_transfer_dtype is not None:
         # cast BEFORE the round loop so every _ring_one_round call sees the
         # same block dtype — the in-body cast would otherwise retrace and
         # recompile the whole sharded round between round 0 (compute dtype)
@@ -351,9 +437,17 @@ def all_knn_ring_resumable(
             bwd_p = bwd_p.astype(jnp.dtype(cfg.ring_transfer_dtype))
     block = jax.device_put(corpus_p, c_sharding)
     block_ids = jax.device_put(corpus_ids, c_sharding)
+    block_scale = (
+        jax.device_put(corpus_scale, c_sharding)
+        if corpus_scale is not None else None
+    )
     if bidir:
         block_b = jax.device_put(bwd_p, c_sharding)
         block_b_ids = jax.device_put(bwd_ids, c_sharding)
+        block_b_scale = (
+            jax.device_put(bwd_scale, c_sharding)
+            if bwd_scale is not None else None
+        )
     queries_p = jax.device_put(queries_p, q_sharding)
     qids_p = jax.device_put(qids_p, q_sharding)
     carry_d = jax.device_put(carry_d, q_sharding)
@@ -362,10 +456,10 @@ def all_knn_ring_resumable(
     total = rounds_total if stop_after_rounds is None else min(
         rounds_total, start_round + stop_after_rounds
     )
+    quantized = cfg.ring_transfer_dtype == "int8"
     for r in range(start_round, total):
         if bidir:
-            (block, block_ids, block_b, block_b_ids,
-             carry_d, carry_i) = _ring_one_round_bidir(
+            out = _ring_one_round_bidir(
                 queries_p,
                 qids_p,
                 block,
@@ -385,9 +479,17 @@ def all_knn_ring_resumable(
                 # degenerate rounds (r=0; the antipodal round at even P)
                 # merge the forward traveler only — see ring.bidir_rounds
                 merge_bwd=(1 <= r < bwd_limit),
+                fblock_scale=block_scale,
+                bblock_scale=block_b_scale if bidir else None,
             )
+            if quantized:
+                (block, block_scale, block_ids, block_b, block_b_scale,
+                 block_b_ids, carry_d, carry_i) = out
+            else:
+                (block, block_ids, block_b, block_b_ids,
+                 carry_d, carry_i) = out
         else:
-            block, block_ids, carry_d, carry_i = _ring_one_round(
+            out = _ring_one_round(
                 queries_p,
                 qids_p,
                 block,
@@ -402,7 +504,12 @@ def all_knn_ring_resumable(
                 c_tile,
                 q_axis=q_axis,
                 rotate=(r + 1 < rounds_total),
+                block_scale=block_scale,
             )
+            if quantized:
+                block, block_scale, block_ids, carry_d, carry_i = out
+            else:
+                block, block_ids, carry_d, carry_i = out
         done = r + 1
         if checkpoint_dir is not None and (
             done % save_every == 0 or done == rounds_total

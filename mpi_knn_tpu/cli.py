@@ -108,11 +108,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "blocks circulate both torus directions at once, "
                    "floor(P/2)+1 rounds, same results bit-identically — "
                    "the comm critical path halves on real ICI)")
-    k.add_argument("--ring-transfer-dtype", choices=["bfloat16", "float32"],
+    k.add_argument("--ring-transfer-dtype",
+                   choices=["bfloat16", "float32", "int8"],
                    default=None,
                    help="dtype of the corpus block while it rotates the "
                    "ring; bfloat16 halves ICI bytes per hop (cast once, "
-                   "upcast per round — exact on integer-valued data)")
+                   "upcast per round — exact on integer-valued data); "
+                   "int8 is the block-scaled quantized level (~4x fewer "
+                   "wire bytes; requires --precision-policy mixed so the "
+                   "exact rerank absorbs the quantization)")
     k.add_argument("--pallas-variant", choices=["tiles", "sweep"],
                    default="tiles",
                    help="pallas backend kernel shape: per-tile top-k + XLA "

@@ -14,6 +14,12 @@ from typing import Optional, Sequence
 
 BACKENDS = ("auto", "serial", "ring", "ring-overlap", "pallas")
 METRICS = ("l2", "cosine")
+# dtypes a corpus block may travel the ring at (None = the compute dtype):
+# bfloat16 halves the ICI bytes per hop; int8 is the block-scaled
+# quantized level (codes + per-row f32 scales, ops/quant.py) at ~4× fewer
+# bytes — and requires precision_policy="mixed" so the exact HIGHEST
+# rerank finish absorbs the quantization noise (see __post_init__).
+RING_TRANSFER_DTYPES = (None, "bfloat16", "float32", "int8")
 TOPK_METHODS = ("exact", "approx", "approx-rerank", "block", "bf16")
 PRECISION_POLICIES = ("exact", "mixed")
 MERGE_SCHEDULES = ("stream", "twolevel")
@@ -129,6 +135,16 @@ class KNNConfig:
     # valued data (raw pixels ≤ 255) the cast is exact; on centered data
     # it costs about what DEFAULT matmul precision costs (~0.3% recall@10,
     # BASELINE.md) — the recall gate measures it either way.
+    # "int8" is the block-scaled quantized level (ops/quant.py): the block
+    # is quantized ONCE at shard time to (int8 codes, f32 per-row scales),
+    # BOTH circulate every schedule's permutes (~4× fewer wire bytes than
+    # f32; R4 prices the payload at the wire dtype), and each round
+    # dequantizes directly into the compress dot. Requires
+    # precision_policy="mixed": the rerank is exact w.r.t. the
+    # DEQUANTIZED rows, which bounds the loss at the measured gate
+    # (>= 0.99 recall@10, tests/test_quant.py; the bytes-vs-recall
+    # ladder is tabulated in DESIGN.md §6) — under "exact" there is no
+    # rerank at all, so that combination is refused loudly.
     ring_transfer_dtype: Optional[str] = None
     # rotation schedule of the ring backends:
     # "uni"   — the reference's one-directional ring (rank → rank+1,
@@ -247,10 +263,26 @@ class KNNConfig:
                 f"pallas_variant must be one of {PALLAS_VARIANTS}, got "
                 f"{self.pallas_variant!r}"
             )
-        if self.ring_transfer_dtype not in (None, "bfloat16", "float32"):
+        if self.ring_transfer_dtype not in RING_TRANSFER_DTYPES:
+            # the error text enumerates the ACCEPTED set (RING_TRANSFER_
+            # DTYPES) instead of hand-listing values: a hand-written list
+            # already drifted once when int8 landed (ISSUE 9 satellite)
             raise ValueError(
-                "ring_transfer_dtype must be None, 'bfloat16' or 'float32', "
+                f"ring_transfer_dtype must be one of {RING_TRANSFER_DTYPES}, "
                 f"got {self.ring_transfer_dtype!r}"
+            )
+        if (
+            self.ring_transfer_dtype == "int8"
+            and self.precision_policy != "mixed"
+        ):
+            raise ValueError(
+                "ring_transfer_dtype='int8' requires precision_policy="
+                "'mixed': the block-scaled quantized block is dequantized "
+                "into the compress dot and the exact HIGHEST rerank finish "
+                "absorbs the quantization noise — under precision_policy="
+                f"{self.precision_policy!r} there is no rerank, so int8 "
+                "transfer would silently degrade every distance instead of "
+                "only the preselect keys"
             )
         if self.ring_schedule not in RING_SCHEDULES:
             raise ValueError(
@@ -267,13 +299,24 @@ class KNNConfig:
                 f"precision_policy must be one of {PRECISION_POLICIES}, got "
                 f"{self.precision_policy!r}"
             )
+        if self.dtype in ("int8", "int4") and self.partitions is None:
+            raise ValueError(
+                f"dtype={self.dtype!r} is the clustered (IVF) store's "
+                "block-scaled AT-REST compression (ivf/index.py): the dense "
+                "backends have no dequantization path, so an integer "
+                "compute dtype would silently score raw codes — set "
+                "partitions to build a clustered index, or use "
+                "ring_transfer_dtype='int8' for wire-only compression"
+            )
         if self.precision_policy == "mixed":
-            if self.dtype != "float32":
+            if self.dtype not in ("float32", "int8", "int4"):
                 raise ValueError(
                     "precision_policy='mixed' requires dtype='float32' "
-                    f"(got {self.dtype!r}): bf16 inputs already run the "
-                    "single-pass dot everywhere, and the f64 debug mode "
-                    "must not downcast"
+                    "(or the clustered store's at-rest 'int8'/'int4', "
+                    "whose dequantized candidates the compress dot "
+                    f"consumes in f32) — got {self.dtype!r}: bf16 inputs "
+                    "already run the single-pass dot everywhere, and the "
+                    "f64 debug mode must not downcast"
                 )
             if self.matmul_precision is not None:
                 raise ValueError(

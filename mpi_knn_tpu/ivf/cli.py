@@ -74,9 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "layout is derived at serve time, so the saved index "
                    "is the same artifact); pallas is refused")
     k.add_argument("--dtype", default="float32",
-                   choices=["float32", "bfloat16"],
+                   choices=["float32", "bfloat16", "int8", "int4"],
                    help="bucket-store at-rest dtype; bfloat16 halves "
-                   "resident HBM and probe-gather bytes")
+                   "resident HBM and probe-gather bytes; int8/int4 are "
+                   "the block-scaled quantized levels (~4x/8x cuts, "
+                   "codes + per-row scales, asymmetric distance with "
+                   "exact f32 queries — ops/quant.py)")
     k.add_argument("--kmeans-iters", type=int, default=25,
                    help="fixed Lloyd iteration budget (single compiled "
                    "executable)")

@@ -17,6 +17,16 @@ Layout (all device-resident after build):
 ``dtype="bfloat16"`` stores buckets compressed at rest (half the HBM and
 half the probe-gather bytes; candidates upcast to f32 after the gather) —
 the same measured-recall contract as the compressed serve index.
+``dtype="int8"``/``"int4"`` go further down the ladder (ops/quant.py):
+buckets reside as block-scaled codes (int4 nibble-packed into int8
+lanes) plus a per-row f32 scale table — 4–8× less HBM and probe-gather
+traffic than f32 — and the search dequantizes candidates right after the
+probe gather into an asymmetric distance (exact f32 queries vs
+dequantized candidates; ``bucket_sqs`` holds the DEQUANTIZED store's
+norms, so distances are exact w.r.t. the stored values). The recall each
+level pays is measured, never assumed: the bench compression axis and
+DESIGN.md's ladder table carry the numbers, and the int4 gate's bar is
+the honestly measured one.
 
 ``nprobe`` auto-tuning: when the build config leaves ``nprobe=None``, a
 held-out corpus sample is searched at doubling nprobe values and compared
@@ -33,6 +43,7 @@ native bfloat16).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 from typing import Optional
 
@@ -44,13 +55,27 @@ from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ivf.kmeans import kmeans
 from mpi_knn_tpu.ivf.search import search_ivf
 from mpi_knn_tpu.ops.distance import sq_norms
+from mpi_knn_tpu.ops.quant import (
+    QUANT_DTYPES,
+    dequantize_rows,
+    quantize_rows,
+    row_wire_bytes,
+)
 from mpi_knn_tpu.parallel.partition import pad_to_multiple
 
 # held-out sample size for recall-targeted nprobe tuning (the CLI/bench
 # recall-gate convention: enough rows for a stable estimate, cheap enough
 # to run at build time)
 TUNE_SAMPLE = 256
-IVF_DTYPES = ("float32", "bfloat16")
+# at-rest bucket-store dtypes: the float pair stores rows verbatim (bf16
+# halves bytes); int8/int4 are the block-scaled quantized levels — codes
+# (int4 nibble-packed into int8 lanes) + a per-row f32 scale table, 4–8×
+# less resident HBM, dequantized after the probe gather into an
+# asymmetric distance (exact f32 queries vs dequantized candidates).
+# int8 costs ~1 recall@10 point on the SIFT-shaped gate; int4 is the
+# capacity rung with an explicitly measured (larger) cost — the bench's
+# compression axis and DESIGN.md's ladder table carry the numbers.
+IVF_DTYPES = ("float32", "bfloat16") + QUANT_DTYPES
 
 
 @dataclasses.dataclass
@@ -73,27 +98,43 @@ class IVFIndex:
     mu: object | None  # centering mean (host f64), or None
     centroids: jax.Array  # (P, d) f32
     centroid_sqs: jax.Array  # (P,)
-    buckets: jax.Array  # (P, cap, d) at-rest dtype
+    buckets: jax.Array  # (P, cap, d) at-rest dtype — (P, cap, pd) int8
+    # code lanes when the store is quantized (pd = packed_dim)
     bucket_ids: jax.Array  # (P, cap) int32
-    bucket_sqs: jax.Array  # (P, cap) f32
+    bucket_sqs: jax.Array  # (P, cap) f32 (norms of the DEQUANTIZED store
+    # when quantized — distances are exact w.r.t. the stored values)
+    bucket_scales: jax.Array | None = None  # (P, cap) f32, quantized only
     tuned_recall: float | None = None  # measured recall@k at `nprobe`
     backend: str = "ivf"
     # per-index executable cache: {(bucket, cfg) -> engine._BucketExec}
     _cache: dict = dataclasses.field(default_factory=dict)
 
     @property
+    def store_dtype(self) -> str:
+        """The at-rest level of the bucket store (cfg.dtype by the build
+        contract)."""
+        return self.cfg.dtype
+
+    @property
     def nbytes_resident(self) -> int:
-        """Bytes of resident corpus payload (the bucket store)."""
-        return self.buckets.size * self.buckets.dtype.itemsize
+        """Bytes of resident corpus payload (the bucket store: code/row
+        array plus the scale table of a quantized store)."""
+        n = self.buckets.size * self.buckets.dtype.itemsize
+        if self.bucket_scales is not None:
+            n += self.bucket_scales.size * self.bucket_scales.dtype.itemsize
+        return n
 
     @property
     def probe_bytes(self) -> int:
         """Bytes one query row's probe gather touches at the index-default
         nprobe — the sublinear bound (≤ nprobe·bucket_bytes, never the
-        corpus) that lint rule R2 budgets on the lowered program."""
-        return (
-            self.nprobe * self.bucket_cap * self.dim
-            * self.buckets.dtype.itemsize
+        corpus) that lint rule R2 budgets on the lowered program. Priced
+        at the AT-REST width: a quantized store's gather moves code lanes
+        plus per-row scales, which is exactly the 4–8× cut."""
+        return self.nprobe * self.bucket_cap * row_wire_bytes(
+            self.dim,
+            self.store_dtype if self.store_dtype in QUANT_DTYPES else None,
+            self.buckets.dtype.itemsize,
         )
 
     def compatible_cfg(self, cfg: KNNConfig) -> KNNConfig:
@@ -267,12 +308,27 @@ def build_ivf_index(
     buckets_np[sa, within] = X[order]
     ids_np[sa, within] = order
 
-    dtype = jnp.dtype(cfg.dtype)
-    buckets = jnp.asarray(buckets_np).astype(dtype)
+    bucket_scales = None
+    if cfg.dtype in QUANT_DTYPES:
+        # block-scaled quantized store: per-row codes + scales (padding
+        # rows are zero → scale 0, codes 0 — dequantization is exactly
+        # zero and the id −1 mask keeps them non-answers anyway); norms
+        # computed UNDER JIT from the DEQUANTIZED store so the asymmetric
+        # distance is exact w.r.t. the values actually stored
+        buckets, bucket_scales = jax.jit(
+            functools.partial(quantize_rows, dtype=cfg.dtype)
+        )(jnp.asarray(buckets_np))
+        bucket_sqs = jax.jit(
+            lambda c, s: jax.vmap(sq_norms)(
+                dequantize_rows(c, s, cfg.dtype, dim)
+            )
+        )(buckets, bucket_scales)
+    else:
+        buckets = jnp.asarray(buckets_np).astype(jnp.dtype(cfg.dtype))
+        # norms from the AT-REST buckets, under jit (bit-parity with the
+        # serial serve index's norm construction)
+        bucket_sqs = jax.jit(jax.vmap(sq_norms))(buckets)
     bucket_ids = jnp.asarray(ids_np)
-    # norms from the AT-REST buckets, under jit (bit-parity with the
-    # serial serve index's norm construction)
-    bucket_sqs = jax.jit(jax.vmap(sq_norms))(buckets)
     centroids = res.centroids
     centroid_sqs = jax.jit(sq_norms)(centroids)
 
@@ -281,6 +337,7 @@ def build_ivf_index(
         nprobe=cfg.nprobe or P, mu=mu,
         centroids=centroids, centroid_sqs=centroid_sqs,
         buckets=buckets, bucket_ids=bucket_ids, bucket_sqs=bucket_sqs,
+        bucket_scales=bucket_scales,
     )
     if cfg.nprobe is None:
         tuned, rec = tune_nprobe(index, cfg.recall_target, k=cfg.k)
@@ -316,11 +373,21 @@ def tune_nprobe(
     pos_of = np.full(index.m, -1, dtype=np.int64)
     valid = flat_ids >= 0
     pos_of[flat_ids[valid]] = np.flatnonzero(valid)
-    Q = np.asarray(
-        index.buckets.reshape(-1, index.dim)[
-            jnp.asarray(pos_of[rows])
-        ].astype(jnp.float32)
-    )
+    sel = index.buckets.reshape(-1, index.buckets.shape[-1])[
+        jnp.asarray(pos_of[rows])
+    ]
+    if index.bucket_scales is not None:
+        # quantized store: the tuner's held-out queries are the
+        # DEQUANTIZED rows — still "corpus rows in the centered frame",
+        # and still isolating partition-pruning loss (both the probed
+        # search and its nprobe=partitions oracle see the same store)
+        sel = dequantize_rows(
+            sel,
+            index.bucket_scales.reshape(-1)[jnp.asarray(pos_of[rows])],
+            index.store_dtype,
+            index.dim,
+        )
+    Q = np.asarray(sel.astype(jnp.float32))
     qids = rows.astype(np.int32)
 
     base_cfg = index.cfg.replace(nprobe=P, k=k)
@@ -385,6 +452,10 @@ def save_ivf_index(index, path: str) -> str:
         "nprobe": index.nprobe,
         "tuned_recall": index.tuned_recall,
         "buckets_bf16": bf16,
+        # the at-rest level by name (int8/int4 stores travel as their
+        # int8 code lanes — bit-identical by construction); absent in
+        # pre-quantization artifacts, defaulted on load
+        "store_dtype": index.cfg.dtype,
         "has_mu": index.mu is not None,
     }
     np.savez(
@@ -395,6 +466,9 @@ def save_ivf_index(index, path: str) -> str:
         buckets=buckets,
         bucket_ids=np.asarray(index.bucket_ids),
         bucket_sqs=np.asarray(index.bucket_sqs),
+        bucket_scales=(np.asarray(index.bucket_scales)
+                       if index.bucket_scales is not None
+                       else np.zeros(0, np.float32)),
         mu=(np.asarray(index.mu)
             if index.mu is not None else np.zeros(0)),
     )
@@ -414,6 +488,12 @@ def load_ivf_index(path: str) -> IVFIndex:
             buckets = jnp.asarray(buckets.view(ml_dtypes.bfloat16))
         else:
             buckets = jnp.asarray(buckets)
+        store = meta.get("store_dtype", cfg.dtype)
+        scales = None
+        if store in QUANT_DTYPES:
+            scales = jnp.asarray(z["bucket_scales"]).reshape(
+                meta["partitions"], meta["bucket_cap"]
+            )
         return IVFIndex(
             cfg=cfg,
             m=meta["m"],
@@ -428,4 +508,5 @@ def load_ivf_index(path: str) -> IVFIndex:
             buckets=buckets,
             bucket_ids=jnp.asarray(z["bucket_ids"]),
             bucket_sqs=jnp.asarray(z["bucket_sqs"]),
+            bucket_scales=scales,
         )

@@ -35,6 +35,7 @@ import numpy as np
 
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ops.distance import pairwise_sq_l2, sq_norms
+from mpi_knn_tpu.ops.quant import dequantize_rows
 from mpi_knn_tpu.ops.rerank import (
     mixed_applies,
     overfetch_width,
@@ -119,23 +120,37 @@ def ivf_query_tile(
     q_ids: jax.Array,  # (q_tile,)
     centroids: jax.Array,  # (P, d) f32
     centroid_sqs: jax.Array,  # (P,)
-    buckets: jax.Array,  # (P, cap, d) at-rest dtype
+    buckets: jax.Array,  # (P, cap, d) at-rest dtype — int8 code lanes
+    # (packed for int4) when the store is quantized
     bucket_ids: jax.Array,  # (P, cap) int32, -1 padding
-    bucket_sqs: jax.Array,  # (P, cap) f32 exact norms
+    bucket_sqs: jax.Array,  # (P, cap) f32 norms of the dequantized store
+    bucket_scales: jax.Array | None,  # (P, cap) f32 per-row scales
     cfg: KNNConfig,
     nprobe: int,
 ):
     """One query tile through the two-stage search → ((q_tile, k) dists
     ascending, ids). The single tile body behind the one-shot wrapper,
-    the serving engine's bucket-cache cells, and the lint lowering."""
+    the serving engine's bucket-cache cells, and the lint lowering.
+
+    A quantized store (``cfg.dtype`` int8/int4) changes exactly one
+    thing: the probe gather moves CODE lanes (1/4–1/8 the f32 bytes —
+    what R2's quantized gather budget prices) plus the tiny scale table,
+    and the candidates are dequantized right after the gather — the
+    asymmetric distance (exact f32 queries vs dequantized candidates)
+    then runs through the same compress/rerank finish as every other
+    store."""
     acc = jnp.float32
     q_x = q_x.astype(acc)
+    dim = centroids.shape[1]  # logical d (buckets may hold packed lanes)
     q_sq, probe = score_centroids(q_x, centroids, centroid_sqs, nprobe)
     cap = buckets.shape[1]
     v = nprobe * cap
     rows = jnp.take(buckets, probe, axis=0).reshape(-1, v, buckets.shape[2])
     ids = jnp.take(bucket_ids, probe, axis=0).reshape(-1, v)
     sqs = jnp.take(bucket_sqs, probe, axis=0).reshape(-1, v)
+    if bucket_scales is not None:
+        scl = jnp.take(bucket_scales, probe, axis=0).reshape(-1, v)
+        rows = dequantize_rows(rows, scl, cfg.dtype, dim)
     rows = rows.astype(acc)
     return finish_candidates(q_x, q_ids, q_sq, rows, ids, sqs, cfg)
 
@@ -150,6 +165,7 @@ def ivf_serve_chunk(
     buckets: jax.Array,
     bucket_ids: jax.Array,
     bucket_sqs: jax.Array,
+    bucket_scales: jax.Array | None,
     cfg: KNNConfig,
     nprobe: int,
 ):
@@ -165,7 +181,7 @@ def ivf_serve_chunk(
         q_x, q_ids, cd_, ci_ = args
         d, i = ivf_query_tile(
             q_x, q_ids, centroids, centroid_sqs, buckets, bucket_ids,
-            bucket_sqs, cfg, nprobe,
+            bucket_sqs, bucket_scales, cfg, nprobe,
         )
         return merge_topk(cd_, ci_, d.astype(cd_.dtype), i, method="exact")
 
@@ -241,7 +257,8 @@ def run_query_tiles(index, q_tiles, qid_tiles, cfg: KNNConfig):
     return _ivf_serve_jit(
         q_tiles, qid_tiles, carry_d, carry_i,
         index.centroids, index.centroid_sqs, index.buckets,
-        index.bucket_ids, index.bucket_sqs, cfg, cfg.nprobe,
+        index.bucket_ids, index.bucket_sqs, index.bucket_scales,
+        cfg, cfg.nprobe,
     )
 
 

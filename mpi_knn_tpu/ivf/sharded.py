@@ -76,6 +76,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mpi_knn_tpu.config import KNNConfig
 from mpi_knn_tpu.ivf.index import IVFIndex, _refuse_inert_knobs
 from mpi_knn_tpu.ivf.search import finish_candidates, score_centroids
+from mpi_knn_tpu.ops.quant import (
+    QUANT_DTYPES,
+    dequantize_rows,
+    row_wire_bytes,
+)
 from mpi_knn_tpu.ops.topk import init_topk_tiles, merge_topk
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import pad_to_multiple
@@ -108,14 +113,36 @@ def exchange_elems(shards: int, route_cap: int, cap: int, dim: int) -> int:
 
 
 def exchange_bytes_per_tile(
-    shards: int, route_cap: int, cap: int, dim: int, itemsize: int
+    shards: int, route_cap: int, cap: int, dim: int, itemsize: int,
+    scale_bytes: int = 0,
 ) -> int:
-    """Total bytes the four all-to-alls of ONE query tile move per shard:
-    the s32 request table plus rows (at-rest dtype) + ids (s32) + norms
-    (f32) per route. Static per executable — the serving engine stamps it
-    into the exchange-bytes counter without reading the device."""
-    per_route = 4 + cap * (dim * itemsize + 4 + 4)
+    """Total bytes the exchange all-to-alls of ONE query tile move per
+    shard: the s32 request table plus rows (at-rest width — a quantized
+    store's rows are its int8 code lanes, so callers pass the PACKED dim
+    and itemsize 1) + ids (s32) + norms (f32) + ``scale_bytes`` (4 for a
+    quantized store's per-row f32 scale, which rides its own all-to-all)
+    per route. Static per executable — the serving engine stamps it into
+    the exchange-bytes counter without reading the device, and R4 holds
+    the compiled payload to it at the WIRE dtype."""
+    per_route = 4 + cap * (dim * itemsize + 4 + 4 + scale_bytes)
     return shards * route_cap * per_route
+
+
+def exchange_wire_args(index) -> tuple[int, int, int]:
+    """(dim_lanes, itemsize, scale_bytes) of one candidate row on the
+    exchange wire for an index — the adapter every
+    :func:`exchange_bytes_per_tile` caller shares so the declared budget
+    always prices the store that actually ships."""
+    if getattr(index, "store_dtype", None) in QUANT_DTYPES:
+        return index.buckets.shape[-1], 1, 4
+    return index.dim, index.buckets.dtype.itemsize, 0
+
+
+def expected_exchange_alltoalls(index) -> int:
+    """Collectives of one routed tile: the request table + the
+    rows/ids/norms returns (4), plus the scale-table return of a
+    quantized store (5) — the count R4 pins in the lowered program."""
+    return 5 if getattr(index, "store_dtype", None) in QUANT_DTYPES else 4
 
 
 def sharded_query_shapes(
@@ -157,9 +184,11 @@ def routed_query_tile(
     q_ids: jax.Array,  # (q_tile,)
     centroids: jax.Array,  # (P, d) replicated routing table
     centroid_sqs: jax.Array,  # (P,)
-    buckets: jax.Array,  # (per_shard, cap, d) THIS shard's slice
+    buckets: jax.Array,  # (per_shard, cap, d) THIS shard's slice —
+    # (per_shard, cap, pd) int8 code lanes for a quantized store
     bucket_ids: jax.Array,  # (per_shard, cap)
     bucket_sqs: jax.Array,  # (per_shard, cap)
+    bucket_scales: jax.Array | None,  # (per_shard, cap) f32, quantized only
     cfg: KNNConfig,
     nprobe: int,
     axis: str,
@@ -209,16 +238,23 @@ def routed_query_tile(
     # (empty slots gather slot 0 but their ids are masked to −1, which
     # the shared mask_tile semantics turn into +inf candidates)
     take = jnp.clip(req_in, 0, per_shard - 1)
-    rows_out = buckets[take]  # (S, route_cap, cap, d) at-rest dtype
+    rows_out = buckets[take]  # (S, route_cap, cap, d|pd) at-rest dtype
     ids_out = jnp.where((req_in < 0)[..., None], -1, bucket_ids[take])
     sqs_out = bucket_sqs[take]
 
     # candidate exchange: after these, row s holds owner s's answers to
-    # OUR requests — rows travel at the at-rest dtype (bf16 store =
-    # half the exchange bytes)
+    # OUR requests — rows travel at the at-rest dtype (bf16 store = half
+    # the exchange bytes; a quantized store ships its int8 code lanes at
+    # a 4–8× cut, with the per-row scale table riding a fifth, d×-smaller
+    # all-to-all — ids and norms are unchanged)
     rows_home = jax.lax.all_to_all(rows_out, axis, 0, 0, tiled=True)
     ids_home = jax.lax.all_to_all(ids_out, axis, 0, 0, tiled=True)
     sqs_home = jax.lax.all_to_all(sqs_out, axis, 0, 0, tiled=True)
+    scl_home = None
+    if bucket_scales is not None:
+        scl_home = jax.lax.all_to_all(
+            bucket_scales[take], axis, 0, 0, tiled=True
+        )
 
     # scatter back to per-query candidate tiles in QUERY-major flat probe
     # order — the exact (q_tile, nprobe·cap) layout the single-device
@@ -235,7 +271,15 @@ def routed_query_tile(
     )
     sqs = sqs_home.reshape(shards * route_cap, cap)[src]
     v = nprobe * cap
-    rows = rows.reshape(qt, v, rows.shape[-1]).astype(acc)
+    rows = rows.reshape(qt, v, rows.shape[-1])
+    if scl_home is not None:
+        # dequantize AT HOME, after the scatter: the exchange moved only
+        # code lanes; the f32 candidate rows exist for exactly one tile's
+        # finish (the same asymmetric-distance shape as the single-device
+        # quantized gather, so the shared finish stays bit-compatible)
+        scl = scl_home.reshape(shards * route_cap, cap)[src].reshape(qt, v)
+        rows = dequantize_rows(rows, scl, cfg.dtype, q_x.shape[1])
+    rows = rows.astype(acc)
     d_out, i_out = finish_candidates(
         q_x, q_ids, q_sq, rows, ids.reshape(qt, v), sqs.reshape(qt, v), cfg
     )
@@ -255,9 +299,10 @@ def ivf_sharded_serve_chunk(
     stats_scratch: jax.Array,  # (N_STATS·S,) donated zeros
     centroids: jax.Array,  # (P, d) replicated
     centroid_sqs: jax.Array,
-    buckets: jax.Array,  # (S·per_shard, cap, d) sharded over axis
+    buckets: jax.Array,  # (S·per_shard, cap, d|pd) sharded over axis
     bucket_ids: jax.Array,
     bucket_sqs: jax.Array,
+    bucket_scales: jax.Array | None,  # sharded like buckets, quantized only
     cfg: KNNConfig,
     nprobe: int,
     mesh: Mesh,
@@ -270,12 +315,14 @@ def ivf_sharded_serve_chunk(
     <resident…>) convention with the stats vector as a THIRD donated
     scratch (``donate_argnums=(2, 3, 4)``): every output aliases a
     donated input, so R5's contract holds with the stats riding along."""
+    qspec = P(axis)
 
-    def shard_body(qt, qidt, cd, ci, st, cent, cent_sq, bks, bids, bsqs):
+    def per_shard_search(qt, qidt, cd, ci, st, cent, cent_sq, bks, bids,
+                         bsqs, bscls):
         def per_tile(args):
             q_x, q_ids, cd0, ci0 = args
             d, i, ts = routed_query_tile(
-                q_x, q_ids, cent, cent_sq, bks, bids, bsqs,
+                q_x, q_ids, cent, cent_sq, bks, bids, bsqs, bscls,
                 cfg, nprobe, axis, shards, route_cap,
             )
             d2, i2 = merge_topk(
@@ -289,17 +336,37 @@ def ivf_sharded_serve_chunk(
         # int32 scratch (R5 would rightly flag the dropped donation)
         return d, i, st + jnp.sum(ts, axis=0, dtype=jnp.int32)
 
-    qspec = P(axis)
+    if bucket_scales is None:
+
+        def shard_body(qt, qidt, cd, ci, st, cent, cent_sq, bks, bids,
+                       bsqs):
+            return per_shard_search(
+                qt, qidt, cd, ci, st, cent, cent_sq, bks, bids, bsqs, None
+            )
+
+        fn = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec, qspec, qspec, P(), P(),
+                      qspec, qspec, qspec),
+            out_specs=(qspec, qspec, qspec),
+        )
+        return fn(
+            q_tiles, qid_tiles, carry_d, carry_i, stats_scratch,
+            centroids, centroid_sqs, buckets, bucket_ids, bucket_sqs,
+        )
+
     fn = shard_map(
-        shard_body,
+        per_shard_search,
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, qspec, qspec, P(), P(),
-                  qspec, qspec, qspec),
+                  qspec, qspec, qspec, qspec),
         out_specs=(qspec, qspec, qspec),
     )
     return fn(
         q_tiles, qid_tiles, carry_d, carry_i, stats_scratch,
         centroids, centroid_sqs, buckets, bucket_ids, bucket_sqs,
+        bucket_scales,
     )
 
 
@@ -334,18 +401,29 @@ class ShardedIVFIndex:
     axis: str
     centroids: jax.Array  # (P, d) replicated on every shard
     centroid_sqs: jax.Array  # (P,) replicated
-    buckets: jax.Array  # (S·per_shard, cap, d) sharded over the ring axis
+    buckets: jax.Array  # (S·per_shard, cap, d|pd) sharded over the ring axis
     bucket_ids: jax.Array  # (S·per_shard, cap) sharded
     bucket_sqs: jax.Array  # (S·per_shard, cap) sharded
+    bucket_scales: jax.Array | None = None  # sharded; quantized stores only
     tuned_recall: float | None = None
     backend: str = "ivf-sharded"
     _cache: dict = dataclasses.field(default_factory=dict)
 
     @property
+    def store_dtype(self) -> str:
+        """The at-rest level of the bucket store (cfg.dtype by the build
+        contract)."""
+        return self.cfg.dtype
+
+    @property
     def nbytes_resident(self) -> int:
         """Bytes of resident corpus payload across ALL shards (the global
-        bucket store, incl. derived padding clusters)."""
-        return self.buckets.size * self.buckets.dtype.itemsize
+        bucket store incl. derived padding clusters, plus a quantized
+        store's scale table)."""
+        n = self.buckets.size * self.buckets.dtype.itemsize
+        if self.bucket_scales is not None:
+            n += self.bucket_scales.size * self.bucket_scales.dtype.itemsize
+        return n
 
     @property
     def shard_nbytes_resident(self) -> int:
@@ -357,10 +435,12 @@ class ShardedIVFIndex:
     def probe_bytes(self) -> int:
         """Bytes one query row's routed probe touches at the index-default
         nprobe — identical to the single-device bound (the routing moves
-        the same nprobe buckets, just across the mesh)."""
-        return (
-            self.nprobe * self.bucket_cap * self.dim
-            * self.buckets.dtype.itemsize
+        the same nprobe buckets, just across the mesh), priced at the
+        at-rest wire width."""
+        return self.nprobe * self.bucket_cap * row_wire_bytes(
+            self.dim,
+            self.store_dtype if self.store_dtype in QUANT_DTYPES else None,
+            self.buckets.dtype.itemsize,
         )
 
     def compatible_cfg(self, cfg: KNNConfig) -> KNNConfig:
@@ -445,9 +525,14 @@ def shard_ivf_index(
     # host-staged slice + pad of the cluster axis, then ONE device_put
     # per array onto its layout — the plain index's device arrays are not
     # kept alive (callers may drop the unsharded copy)
+    quantized = index.cfg.dtype in QUANT_DTYPES
     buckets = np.asarray(index.buckets)
     bids = np.asarray(index.bucket_ids)
     bsqs = np.asarray(index.bucket_sqs)
+    bscl = (
+        np.asarray(index.bucket_scales)
+        if index.bucket_scales is not None else None
+    )
     if P_pad > P_real:
         padc = P_pad - P_real
         buckets = np.concatenate(
@@ -459,14 +544,25 @@ def shard_ivf_index(
         bsqs = np.concatenate(
             [bsqs, np.zeros((padc,) + bsqs.shape[1:], bsqs.dtype)]
         )
+        if bscl is not None:
+            bscl = np.concatenate(
+                [bscl, np.zeros((padc,) + bscl.shape[1:], bscl.dtype)]
+            )
     csh = NamedSharding(mesh, P(axis))
     rsh = NamedSharding(mesh, P())  # replicated
-    dtype = jnp.dtype(index.cfg.dtype)
     cfg = index.cfg.replace(
         ivf_shards=shards,
         ivf_route_cap=(route_cap if route_cap is not None
                        else index.cfg.ivf_route_cap),
     )
+    if quantized:
+        # the codes are ALREADY the at-rest representation — a cast here
+        # would corrupt them; they shard verbatim alongside their scales
+        buckets_dev = jax.device_put(jnp.asarray(buckets), csh)
+    else:
+        buckets_dev = jax.device_put(
+            jnp.asarray(buckets).astype(jnp.dtype(index.cfg.dtype)), csh
+        )
     return ShardedIVFIndex(
         cfg=cfg,
         m=index.m,
@@ -481,9 +577,12 @@ def shard_ivf_index(
         axis=axis,
         centroids=jax.device_put(np.asarray(index.centroids), rsh),
         centroid_sqs=jax.device_put(np.asarray(index.centroid_sqs), rsh),
-        buckets=jax.device_put(jnp.asarray(buckets).astype(dtype), csh),
+        buckets=buckets_dev,
         bucket_ids=jax.device_put(bids, csh),
         bucket_sqs=jax.device_put(bsqs, csh),
+        bucket_scales=(
+            jax.device_put(bscl, csh) if bscl is not None else None
+        ),
         tuned_recall=index.tuned_recall,
     )
 
@@ -507,6 +606,10 @@ def unshard_ivf_index(index: ShardedIVFIndex) -> IVFIndex:
         buckets=jnp.asarray(np.asarray(index.buckets)[:Pn]),
         bucket_ids=jnp.asarray(np.asarray(index.bucket_ids)[:Pn]),
         bucket_sqs=jnp.asarray(np.asarray(index.bucket_sqs)[:Pn]),
+        bucket_scales=(
+            jnp.asarray(np.asarray(index.bucket_scales)[:Pn])
+            if index.bucket_scales is not None else None
+        ),
         tuned_recall=index.tuned_recall,
     )
 
@@ -603,7 +706,7 @@ def run_sharded_tiles(index: ShardedIVFIndex, q_tiles, qid_tiles,
     return _ivf_sharded_jit(
         q_tiles, qid_tiles, carry_d, carry_i, stats,
         index.centroids, index.centroid_sqs, index.buckets,
-        index.bucket_ids, index.bucket_sqs,
+        index.bucket_ids, index.bucket_sqs, index.bucket_scales,
         cfg, cfg.nprobe, index.mesh, index.axis, index.shards, route_cap,
     )
 

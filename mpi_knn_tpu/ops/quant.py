@@ -1,0 +1,140 @@
+"""Block-scaled integer quantization — the compression layer below bf16
+(the EQuARX direction, PAPERS.md arxiv 2506.17615).
+
+One scheme, two widths, used in two places:
+
+- **transfer** (``KNNConfig.ring_transfer_dtype="int8"``): the corpus block
+  that circulates the ring travels as (int8 codes, f32 per-row scales) —
+  4× fewer ICI bytes per ppermute than f32, 2× fewer than bf16 — and is
+  dequantized into each round's compress dot (backends/ring.py);
+- **at rest** (``IVFIndex`` with ``dtype="int8"``/``"int4"``): the clustered
+  bucket store resides as codes + scales — 4–8× less HBM than f32 — and the
+  probe gather moves codes; dequantization happens after the gather, feeding
+  the asymmetric distance (exact f32 queries vs dequantized candidates) in
+  the compress/rerank stages (ivf/search.py, ivf/sharded.py).
+
+The scheme is symmetric per-row block scaling: for each row (one corpus
+point — the natural "block" here, because rows are the unit every gather,
+permute and dot consumes),
+
+    scale = max|row| / QMAX,     code = round(row / scale) ∈ [−QMAX, QMAX]
+
+so the reconstruction ``code · scale`` is exact at the row's extremes and
+every element's absolute error is ≤ scale/2 (round-to-nearest), which is
+what ``tests/test_quant.py`` property-tests. A zero row gets scale 0 and
+all-zero codes — dequantization is exactly zero, no division anywhere
+(the inverse scale is computed with a ``where`` guard).
+
+``int4`` packs two codes per int8 lane (low nibble first, two's
+complement, QMAX=7 so −8 never appears and negation is involutive); the
+packed axis is ``ceil(d/2)`` bytes with an implicit zero pad for odd d.
+Unpacking is exact by construction (arithmetic shifts), also
+property-tested.
+
+Why scales ride OUTSIDE the quantized payload: a scale folded into the
+codes (e.g. a shared exponent stolen from the mantissa bits) would make
+the wire format opaque to the lint engine; as a separate f32 vector it is
+one more (tiny) array on every permute/all-to-all, and rule R3 can demand
+the convert-and-multiply dequant feeding each compress dot while R4
+prices the payload at the wire dtype (analysis/rules.py).
+
+Everything here is jit-compatible and shape-static; the quantize side is
+normally run ONCE at shard/build time (host-eager or under jit), never
+inside the rotation/search programs — re-quantizing per round would both
+waste FLOPs and, in the overlap schedule, hang a reduce off the permutes'
+backward slice that lint rule R1 would rightly question.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QUANT_DTYPES = ("int8", "int4")
+_QMAX = {"int8": 127, "int4": 7}
+
+
+def quant_max(dtype: str) -> int:
+    """Largest code magnitude of a quantized dtype (symmetric range)."""
+    try:
+        return _QMAX[dtype]
+    except KeyError:
+        raise ValueError(
+            f"quantized dtype must be one of {QUANT_DTYPES}, got {dtype!r}"
+        ) from None
+
+
+def packed_dim(dim: int, dtype: str) -> int:
+    """int8 lanes per row of a ``dim``-wide quantized row: int8 stores one
+    code per lane; int4 packs two (odd dims carry one zero nibble)."""
+    quant_max(dtype)
+    return dim if dtype == "int8" else -(-dim // 2)
+
+
+def row_wire_bytes(dim: int, dtype: str | None, itemsize: int = 4) -> int:
+    """Bytes ONE corpus row's payload occupies at a given transfer/at-rest
+    level (codes + its scale for quantized levels; ``itemsize`` is the
+    float width for the non-quantized levels). The single pricing rule the
+    obs gauges, the R4 wire budgets, and the sharded exchange accounting
+    all share — hand-copied byte math would drift."""
+    if dtype in QUANT_DTYPES:
+        return packed_dim(dim, dtype) + 4  # int8 lanes + one f32 scale
+    return dim * itemsize
+
+
+def pack_int4(codes: jax.Array) -> jax.Array:
+    """Pack int8 codes in [−7, 7] two-per-byte along the last axis (low
+    nibble = even index). Odd-width rows pad with a zero nibble."""
+    d = codes.shape[-1]
+    if d % 2:
+        codes = jnp.concatenate(
+            [codes, jnp.zeros(codes.shape[:-1] + (1,), codes.dtype)], axis=-1
+        )
+    lo = codes[..., 0::2]
+    hi = codes[..., 1::2]
+    # two's-complement nibbles: keep the low 4 bits of each signed code
+    return ((lo & 0x0F) | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array, dim: int) -> jax.Array:
+    """Exact inverse of :func:`pack_int4`: (…, ceil(dim/2)) int8 lanes →
+    (…, dim) int8 codes (sign-extended via arithmetic shifts)."""
+    packed = packed.astype(jnp.int8)
+    lo = (packed << 4) >> 4  # arithmetic shift sign-extends the low nibble
+    hi = packed >> 4
+    out = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],)
+    )
+    return out[..., :dim]
+
+
+def quantize_rows(x: jax.Array, dtype: str = "int8"):
+    """Symmetric per-row block quantization: (…, d) float → ((…, pd) int8
+    codes, (…,) f32 scales) with ``pd = packed_dim(d, dtype)``.
+
+    Max abs reconstruction error is scale/2 per element; zero rows give
+    scale 0 and exact-zero dequantization."""
+    qmax = quant_max(dtype)
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = amax / qmax
+    inv = jnp.where(amax > 0, qmax / jnp.where(amax > 0, amax, 1.0), 0.0)
+    codes = jnp.clip(
+        jnp.round(x * inv[..., None]), -qmax, qmax
+    ).astype(jnp.int8)
+    if dtype == "int4":
+        codes = pack_int4(codes)
+    return codes, scale
+
+
+def dequantize_rows(
+    codes: jax.Array, scales: jax.Array, dtype: str, dim: int
+) -> jax.Array:
+    """(…, pd) int8 codes + (…,) scales → (…, dim) f32 rows. This is THE
+    dequant the lint contract (rule R3) looks for: one convert out of the
+    integer domain and one multiply by the scale, feeding the distance
+    dots — a dot consuming raw codes without its scale is a finding."""
+    quant_max(dtype)
+    if dtype == "int4":
+        codes = unpack_int4(codes, dim)
+    return codes.astype(jnp.float32) * scales[..., None]

@@ -118,6 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="ring rotation schedule (default uni); "
                    "meaningless for a loaded clustered index")
+    k.add_argument("--ring-transfer-dtype",
+                   choices=["bfloat16", "float32", "int8"], default=None,
+                   help="dtype of the corpus block on the rotation wire "
+                   "(ring backends): bfloat16 halves ICI bytes per hop; "
+                   "int8 is the block-scaled quantized level (~4x fewer "
+                   "bytes, requires --precision-policy mixed; the "
+                   "resident index holds codes + scales, so HBM shrinks "
+                   "too — the --report ring_transfer block carries the "
+                   "static wire bytes)")
     k.add_argument("--bucket", type=int, default=1024,
                    help="base row bucket: batches pad to bucket*2^j rows "
                    "and each (bucket, config) compiles exactly once")
@@ -332,6 +341,7 @@ def main(argv=None) -> int:
             topk_method=args.topk_method,
             merge_schedule=args.merge_schedule,
             ring_schedule=args.ring_schedule or "uni",
+            ring_transfer_dtype=args.ring_transfer_dtype,
             num_devices=args.devices,
             query_bucket=args.bucket,
             dispatch_depth=args.dispatch_depth,
@@ -402,6 +412,14 @@ def _serve_loaded_index(args, X, source, policy=None) -> int:
     if args.corpus_tile is not None:
         print("error: --corpus-tile has no meaning with --index-load "
               "(the bucket layout was baked in at build time)",
+              file=sys.stderr)
+        return 2
+    if args.ring_transfer_dtype is not None:
+        print("error: --ring-transfer-dtype has no meaning with "
+              "--index-load: the clustered search never rotates a ring, "
+              "and the store's AT-REST compression (float32/bfloat16/"
+              "int8/int4) was baked in at build time — rebuild with "
+              "`mpi-knn build-index --dtype ...` to change it",
               file=sys.stderr)
         return 2
     if args.ring_schedule is not None:
@@ -524,6 +542,27 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         summary["probe_fraction"] = round(
             cfg.nprobe / index.partitions, 4
         )
+        # the compression-ladder story (ISSUE 9): the at-rest level and
+        # the resident bytes it buys — read next to the recall/latency
+        # this run measured, same numbers the ivf_at_rest_bytes gauge
+        # stamps at lower time
+        summary["at_rest"] = {
+            "dtype": cfg.dtype,
+            "resident_bytes": index.nbytes_resident,
+            "probe_bytes_per_query": index.probe_bytes,
+        }
+    if index.backend in ("ring", "ring-overlap"):
+        from mpi_knn_tpu.backends.ring import ring_wire_bytes_per_batch
+
+        # the transfer level and the static per-batch rotation bytes at
+        # the wire dtype (the ring_transfer_wire_bytes gauge's number)
+        summary["ring_transfer"] = {
+            "dtype": cfg.ring_transfer_dtype or cfg.dtype,
+            "wire_bytes_per_batch": ring_wire_bytes_per_batch(
+                cfg, index.corpus_sharded.shape[0], index.dim,
+                index.ring_meta[3],
+            ),
+        }
     if session.exchange is not None:
         # the sharded candidate-exchange story, summarized where the
         # round is read: routed probe volume, the (counted, loud) probe-

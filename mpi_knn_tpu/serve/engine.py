@@ -272,6 +272,7 @@ def _ring_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
         sds((q_pad, cfg.k), jnp.int32, sharding=qsh),
         index.corpus_sharded,
         index.corpus_ids_sharded,
+        index.corpus_scales_sharded,
         cfg,
         index.backend == "ring-overlap",
         index.mesh,
@@ -330,6 +331,7 @@ def _ivf_lowered(index, cfg: KNNConfig, bucket: int):
         index.buckets,
         index.bucket_ids,
         index.bucket_sqs,
+        index.bucket_scales,
         cfg,
         nprobe,
     )
@@ -360,6 +362,7 @@ def _ivf_sharded_lowered(index, cfg: KNNConfig, bucket: int):
         index.buckets,
         index.bucket_ids,
         index.bucket_sqs,
+        index.bucket_scales,
         cfg,
         nprobe,
         index.mesh,
@@ -449,6 +452,7 @@ def get_executable(
                 from jax.sharding import PartitionSpec
                 from mpi_knn_tpu.ivf.sharded import (
                     exchange_bytes_per_tile,
+                    exchange_wire_args,
                     scratch_maker,
                     sharded_query_shapes,
                 )
@@ -459,9 +463,12 @@ def get_executable(
                     cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket,
                     index.shards,
                 )
+                wire_dim, wire_itemsize, wire_scale = exchange_wire_args(
+                    index
+                )
                 exchange_bytes = qt * exchange_bytes_per_tile(
-                    index.shards, route_cap, index.bucket_cap, index.dim,
-                    index.buckets.dtype.itemsize,
+                    index.shards, route_cap, index.bucket_cap, wire_dim,
+                    wire_itemsize, wire_scale,
                 )
                 qids = jax.device_put(
                     jnp.full((qt, q_tile), -1, jnp.int32), qsh
@@ -482,10 +489,34 @@ def get_executable(
             raise
         index._cache[key] = exec_
         obs_spans.end_span(sid)
-        obs_metrics.get_registry().counter(
+        reg = obs_metrics.get_registry()
+        reg.counter(
             "serve_executables_compiled_total",
             help="(bucket, config) cells compiled by the serve cache",
         ).inc()
+        # compression-ladder gauges, stamped at LOWER time (pure shape
+        # math, no device reads — the sharded exchange-bytes precedent):
+        # the 2×/4×/8× byte cuts of bf16/int8 transfer and bf16/int8/int4
+        # at-rest stores are visible in `mpi-knn metrics` / `--report`
+        # next to the recall they paid.
+        if index.backend in ("ring", "ring-overlap"):
+            from mpi_knn_tpu.backends.ring import ring_wire_bytes_per_batch
+
+            ring_n = index.ring_meta[3]
+            reg.gauge(
+                "ring_transfer_wire_bytes",
+                help="bytes one batch's full corpus rotation moves over "
+                "the interconnect, at the wire dtype (static per "
+                "executable)",
+            ).set(ring_wire_bytes_per_batch(
+                cfg, index.corpus_sharded.shape[0], index.dim, ring_n,
+            ))
+        if index.backend in ("ivf", "ivf-sharded"):
+            reg.gauge(
+                "ivf_at_rest_bytes",
+                help="resident bytes of the clustered bucket store "
+                "(codes + scales for quantized stores)",
+            ).set(index.nbytes_resident)
     return exec_
 
 
@@ -591,6 +622,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             index.buckets,
             index.bucket_ids,
             index.bucket_sqs,
+            index.bucket_scales,
         )
         return (
             d.reshape(exec_.q_pad, cfg.k),
@@ -603,7 +635,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
         d, i, stats = exec_.compiled(
             q2d, qids, carry_d, carry_i, stats0,
             index.centroids, index.centroid_sqs, index.buckets,
-            index.bucket_ids, index.bucket_sqs,
+            index.bucket_ids, index.bucket_sqs, index.bucket_scales,
         )
         return (
             d.reshape(exec_.q_pad, cfg.k),
@@ -618,6 +650,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
         d, i = exec_.compiled(
             q2d, qids, carry_d, carry_i,
             index.corpus_sharded, index.corpus_ids_sharded,
+            index.corpus_scales_sharded,
         )
         return d, i, None
     carry_d, carry_i = init_topk(exec_.q_pad, cfg.k, dtype=acc)

@@ -67,8 +67,13 @@ class CorpusIndex:
     # ring layout
     mesh: Mesh | None = None
     ring_meta: tuple | None = None  # (q_axis, axis, dp, ring_n)
-    corpus_sharded: jax.Array | None = None  # (c_pad, d) over P(axis)
+    corpus_sharded: jax.Array | None = None  # (c_pad, d) over P(axis) —
+    # int8 CODES when cfg.ring_transfer_dtype == "int8" (the resident
+    # corpus IS the wire representation: quantized once at build, so
+    # serving batches pay zero re-quantization and resident HBM shrinks
+    # with the wire bytes)
     corpus_ids_sharded: jax.Array | None = None
+    corpus_scales_sharded: jax.Array | None = None  # (c_pad,) f32, int8 only
     # per-index executable cache: {(bucket, cfg) -> engine._BucketExec}
     _cache: dict = dataclasses.field(default_factory=dict)
 
@@ -183,13 +188,25 @@ def _build_index_resident(corpus, cfg, mesh, backend, m, dim) -> CorpusIndex:
         _, c_tile, _, c_pad = ring_tiles(cfg, m, cfg.query_bucket, dp, ring_n)
         dtype = jnp.dtype(cfg.dtype)
         csh = NamedSharding(mesh, P(axis))
-        corpus_p = jax.device_put(pad_rows_any(corpus, c_pad, dtype=dtype), csh)
+        corpus_p = pad_rows_any(corpus, c_pad, dtype=dtype)
+        corpus_scales = None
+        if cfg.ring_transfer_dtype == "int8":
+            # quantize ONCE at build: the resident shards hold the wire
+            # representation (codes + per-row scales), so every batch's
+            # rotation starts from the already-compressed block and the
+            # serve program only ever dequantizes (backends.ring)
+            from mpi_knn_tpu.backends.ring import quantize_ring_block
+
+            corpus_p, corpus_scales = quantize_ring_block(corpus_p)
+            corpus_scales = jax.device_put(corpus_scales, csh)
+        corpus_p = jax.device_put(corpus_p, csh)
         corpus_ids = jax.device_put(jnp.asarray(make_global_ids(m, c_pad)), csh)
         return CorpusIndex(
             cfg=cfg.replace(backend=backend), backend=backend, m=m, dim=dim,
             c_tile=c_tile, mu=mu, mesh=mesh,
             ring_meta=(q_axis, axis, dp, ring_n),
             corpus_sharded=corpus_p, corpus_ids_sharded=corpus_ids,
+            corpus_scales_sharded=corpus_scales,
         )
 
     if backend == "pallas":

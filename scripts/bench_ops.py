@@ -29,6 +29,13 @@ Two families, one JSON artifact:
   rows pin the recompile-free steady state the engine promises (the
   compile-free property itself is gated in tests/test_serve.py; these
   rows pin its speed).
+- ``ring_xfer`` / ``ivf_at_rest``: the COMPRESSION AXIS (ISSUE 9) —
+  the ring at each transfer level (f32/bf16/int8, one mixed policy so
+  rows differ only in wire bytes) and the clustered store at each
+  at-rest level (f32/bf16/int8/int4, fixed probe count), every row
+  carrying the measured recall@k (and resident bytes for at-rest) so
+  the 2×/4×/8× cuts are committed NEXT TO what they pay — the
+  bytes-vs-recall ladder DESIGN.md tabulates is generated here.
 - ``kmeans`` / ``ivf_query``: the clustered-index path (``mpi_knn_tpu.
   ivf``) on a SIFT-shaped corpus (uniform random data is clusterless and
   would only measure the method failing its preconditions) — one k-means
@@ -228,6 +235,63 @@ def main(argv=None) -> int:
                     ),
                 )
 
+        # -- compression axis, transfer side (ISSUE 9): the ring at each
+        # wire level under ONE policy (mixed — int8 requires the rerank,
+        # and a policy change between rows would confound the byte
+        # effect), with the measured recall@k each level pays riding the
+        # row. Queries are HELD OUT (fresh rows from the same integer-
+        # pixel distribution), NOT corpus rows: a corpus-row query's own
+        # stored row sits at exactly zero distance only in the f32 cell —
+        # a quantized store reconstructs it with noise, zero-exclusion
+        # stops firing, and every quantized row would eat a spurious
+        # self-hit the oracle excluded (a measurement artifact, not
+        # recall).
+        from mpi_knn_tpu.utils.report import recall_at_k
+
+        # held-out = jittered corpus rows (already in the centered frame;
+        # the jitter keeps every query strictly off the corpus so no
+        # level sees an exact-zero match)
+        Qh = (
+            np.asarray(X[:n_ring_q])
+            + np.random.default_rng(7)
+            .normal(0.0, 2.0, (n_ring_q, d))
+            .astype(np.float32)
+        )
+        X64o = np.asarray(X).astype(np.float64)
+        od_x = (
+            (Qh.astype(np.float64) ** 2).sum(1)[:, None]
+            + (X64o**2).sum(1)[None, :]
+            - 2.0 * (Qh.astype(np.float64) @ X64o.T)
+        )
+        oracle_x = np.argsort(od_x, axis=1, kind="stable")[:, :k]
+        for xname, xfer in (("f32", None), ("bf16", "bfloat16"),
+                            ("int8", "int8")):
+            xcfg = KNNConfig(
+                k=k, backend="ring-overlap", precision_policy="mixed",
+                ring_transfer_dtype=xfer, exclude_zero=False,
+                query_tile=min(128, n_ring_q), corpus_tile=min(1024, c),
+            )
+            res = all_knn(np.asarray(X), queries=Qh, config=xcfg, mesh=mesh)
+            xrecall = recall_at_k(res.ids, oracle_x)
+            times = _time(
+                lambda: all_knn(
+                    np.asarray(X), queries=Qh, config=xcfg, mesh=mesh
+                ).dists,
+                reps,
+            )
+            row = {
+                "op": "ring_xfer",
+                "variant": f"mixed-{xname}",
+                "median_s": round(statistics.median(times), 6),
+                "min_s": round(min(times), 6),
+                "reps_s": [round(t, 6) for t in times],
+                "recall_at_k": round(float(xrecall), 4),
+            }
+            results.append(row)
+            print(f"{'ring_xfer':16s} {row['variant']:16s} "
+                  f"median {row['median_s']}s  recall@{k} "
+                  f"{row['recall_at_k']}", flush=True)
+
     # -- query_knn serving throughput at three buckets (resident index) ---
     from mpi_knn_tpu.serve import ServeSession, build_index
 
@@ -351,6 +415,60 @@ def main(argv=None) -> int:
         print(f"{'ivf_query':16s} {row['variant']:16s} "
               f"median {row['median_s']}s  {row['queries_per_s']} q/s  "
               f"recall@{k} {row['recall_at_k']}", flush=True)
+
+    # -- compression axis, at-rest side (ISSUE 9): the clustered store at
+    # every residency level (f32 → bf16 → int8 → int4) at ONE fixed probe
+    # count, with the measured recall@k and the resident bytes on each
+    # row — the 2×/4×/8× cuts and what each costs are one committed
+    # artifact, so a level can never look cheap without showing what it
+    # paid. Same SIFT-shaped corpus and oracle as the ivf_query rows.
+    at_rest_nprobe = min(4, P)
+    for store in ("float32", "bfloat16", "int8", "int4"):
+        sidx_q = build_ivf_index(
+            Xi, KNNConfig(k=k, partitions=P, nprobe=at_rest_nprobe,
+                          query_tile=min(1024, q), query_bucket=128,
+                          dtype=store)
+        )
+        # query_ids → id-based self-exclusion: a quantized store's own
+        # row reconstructs at nonzero distance, so zero-exclusion alone
+        # would let every corpus-row query count a spurious self-hit the
+        # oracle excluded
+        got = search_ivf(
+            sidx_q, Xi[sample], query_ids=sample.astype(np.int32)
+        )[1]
+        recall = recall_at_k(got, oracle_ids)
+        session = ServeSession(sidx_q)
+        bucket = 128
+        n_batches = max(reps, 4)
+        batches = [Xi[(i * bucket) % max(1, c - bucket):][:bucket]
+                   for i in range(n_batches)]
+        session.warm([bucket])
+        session.submit(batches[0])
+        session.drain()
+        session.reset_stats()
+        t0 = time.perf_counter()
+        for b in batches:
+            session.submit(b)
+        session.drain()
+        wall = time.perf_counter() - t0
+        lats = sorted(session.latencies)
+        row = {
+            "op": "ivf_at_rest",
+            "variant": f"p{P}-nprobe{at_rest_nprobe}-{store}",
+            "median_s": round(statistics.median(lats), 6),
+            "min_s": round(min(lats), 6),
+            "reps_s": [round(t, 6) for t in lats],
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "queries_per_s": round(session.queries_served / wall, 1),
+            "recall_at_k": round(float(recall), 4),
+            "at_rest_bytes": sidx_q.nbytes_resident,
+        }
+        results.append(row)
+        print(f"{'ivf_at_rest':16s} {row['variant']:24s} "
+              f"median {row['median_s']}s  {row['queries_per_s']} q/s  "
+              f"recall@{k} {row['recall_at_k']}  "
+              f"{row['at_rest_bytes']} B", flush=True)
 
     # -- SHARDED clustered path: routed candidate exchange over the mesh --
     # The same trained index distributed over 2- and 4-device ring meshes

@@ -87,6 +87,26 @@ note "sharded-IVF lint gate (ISSUE 8: routed candidate exchange)"
 python -m mpi_knn_tpu lint -q --backend ivf-sharded \
     --out artifacts/lint_sharded || fail=1
 
+note "quantization lint gate (ISSUE 9: block-scaled int8/int4)"
+# the quantized cells by name (they also run inside the full sweep above
+# — the named pass exists so a quantization regression is called out as
+# such): the int8-transfer ring cells (R3's quant/dequant contract —
+# exactly one dequant convert + scale multiply feeding each compress
+# dot, no dot touching raw codes; R4's 3-permutes-per-direction
+# accounting with every payload priced at the wire dtype; R1's overlap
+# certification with the scale row in the schedule) and the int8/int4
+# at-rest clustered cells (R2's wire-priced gather bound — dequantize
+# AFTER the gather; the serve cells re-certify R5's donation on
+# quantized bucket-cache programs). The injected counterexamples — raw-
+# code dots, dropped/double dequants, float-sized gathers, float-width
+# rotations under an int8 label — must FIRE (tests/test_hlo_lint.py -k
+# quant), so a green matrix can never be green by vacuity.
+python -m mpi_knn_tpu lint -q --quant xfer-int8 --quant int8 --quant int4 \
+    --out artifacts/lint_quant || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_hlo_lint.py -k quant -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || fail=1
+
 note "fault-injection / resilience suite (ISSUE 6 gate)"
 # the resilience layer's whole fault matrix, exercised on CPU rather than
 # trusted: injected hang → heartbeat-starvation kill with a structured

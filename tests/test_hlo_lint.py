@@ -544,3 +544,196 @@ def test_cli_lint_unknown_rule_is_usage_error(tmp_path):
          str(tmp_path), "-q"]
     )
     assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# quantized-cell counterexamples (ISSUE 9): R3's quant/dequant contract,
+# R2's wire-priced gather bound, R4's wire-priced permute payloads. Each
+# injected module is the exact bug class the quantization layer makes
+# possible — scoring raw codes, dropping the dequant, double-dequanting a
+# compress pass, dequantizing before the gather, rotating float rows
+# under an int8 label — pushed through the production rule path.
+
+
+def _quant_ctx(policy="exact", backend="ivf", **meta):
+    meta.setdefault("q_tile", 8)
+    meta.setdefault("c_tile", 16)
+    meta.setdefault("acc_bytes", 4)
+    meta.setdefault("quantized", True)
+    cfg = KNNConfig(k=4, query_tile=8, corpus_tile=32,
+                    precision_policy=policy)
+    return engine.LintContext(
+        target=lowering.LintTarget(
+            backend, "l2", "float32", policy,
+            quant="int8" if backend == "ivf" else "xfer-int8",
+        ),
+        cfg=cfg,
+        meta=meta,
+    )
+
+
+def test_r3_quant_flags_dot_consuming_raw_codes():
+    """A dot fed raw int8 codes is scoring unscaled integers — a
+    different function, not a precision loss."""
+    mod = """\
+HloModule m, entry_computation_layout={(s8[4,8]{1,0}, s8[16,8]{1,0})->s32[4,16]{1,0}}
+
+ENTRY %main.1 (a.1: s8[4,8], b.1: s8[16,8]) -> s32[4,16] {
+  %a.1 = s8[4,8]{1,0} parameter(0)
+  %b.1 = s8[16,8]{1,0} parameter(1)
+  %cv.1 = f32[4,8]{1,0} convert(%a.1)
+  ROOT %d.1 = s32[4,16]{1,0} dot(%a.1, %b.1), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+"""
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _quant_ctx(), _rules("R3-dtype")
+    )
+    assert findings and "raw int8" in findings[0].message
+    # the identical module under an UNQUANTIZED config is not R3-quant's
+    # business (int8 dots exist legitimately elsewhere)
+    ctx = _quant_ctx()
+    ctx.meta.pop("quantized")
+    findings2, _ = engine.run_rules(
+        {"before_opt": mod}, ctx, _rules("R3-dtype")
+    )
+    assert not findings2
+
+
+def test_r3_quant_flags_missing_dequant_as_vacuous():
+    """A quantized cell whose module contains no s8→float convert never
+    dequantized anything — every other quant check would be vacuous."""
+    mod = """\
+HloModule m, entry_computation_layout={(f32[4,8]{1,0})->f32[4,4]{1,0}}
+
+ENTRY %main.1 (a.1: f32[4,8]) -> f32[4,4] {
+  %a.1 = f32[4,8]{1,0} parameter(0)
+  ROOT %d.1 = f32[4,4]{1,0} dot(%a.1, %a.1), lhs_contracting_dims={1}, rhs_contracting_dims={1}, operand_precision={highest,highest}
+}
+"""
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _quant_ctx(), _rules("R3-dtype")
+    )
+    assert findings and "dequant" in findings[0].message
+
+
+_QUANT_MIXED_TMPL = """\
+HloModule m, entry_computation_layout={(f32[4,8]{1,0}, s8[16,8]{1,0}, s8[16,8]{1,0}, f32[16,8]{1,0})->f32[4,16]{1,0}}
+
+ENTRY %main.1 (q.1: f32[4,8], a.1: s8[16,8], b.1: s8[16,8], s.1: f32[16,8]) -> f32[4,16] {
+  %q.1 = f32[4,8]{1,0} parameter(0)
+  %a.1 = s8[16,8]{1,0} parameter(1)
+  %b.1 = s8[16,8]{1,0} parameter(2)
+  %s.1 = f32[16,8]{1,0} parameter(3)
+  %ca.1 = f32[16,8]{1,0} convert(%a.1)
+%EXTRA%
+  %d.1 = f32[4,16]{1,0} dot(%q.1, %FEED%), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  ROOT %d2.1 = f32[4,16]{1,0} dot(%q.1, %s.1), lhs_contracting_dims={1}, rhs_contracting_dims={1}, operand_precision={highest,highest}
+}
+"""
+
+
+def _quant_mixed_mod(extra, feed):
+    return _QUANT_MIXED_TMPL.replace("%EXTRA%", extra).replace(
+        "%FEED%", feed
+    )
+
+
+def test_r3_quant_mixed_passes_one_dequant_one_multiply():
+    mod = _quant_mixed_mod(
+        "  %m.1 = f32[16,8]{1,0} multiply(%ca.1, %s.1)", "%m.1"
+    )
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _quant_ctx("mixed"), _rules("R3-dtype")
+    )
+    assert not findings, [f.message for f in findings]
+
+
+def test_r3_quant_mixed_flags_two_dequants_feeding_compress_dot():
+    """Two quantized sources merged into one compress pass — a shape the
+    wire/gather budgets do not model (and a likely sign the scales were
+    crossed)."""
+    mod = _quant_mixed_mod(
+        "  %cb.1 = f32[16,8]{1,0} convert(%b.1)\n"
+        "  %ad.1 = f32[16,8]{1,0} add(%ca.1, %cb.1)\n"
+        "  %m.1 = f32[16,8]{1,0} multiply(%ad.1, %s.1)",
+        "%m.1",
+    )
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _quant_ctx("mixed"), _rules("R3-dtype")
+    )
+    assert findings and "2 dequant converts" in findings[0].message
+
+
+def test_r3_quant_mixed_flags_unscaled_codes_at_compress_dot():
+    """The compress dot sees the convert but no scale multiply — the
+    codes are scored unscaled."""
+    mod = _quant_mixed_mod("", "%ca.1")
+    findings, _ = engine.run_rules(
+        {"before_opt": mod}, _quant_ctx("mixed"), _rules("R3-dtype")
+    )
+    assert findings and "NO scale multiply" in findings[0].message
+
+
+def test_r2_quant_flags_float_sized_bucket_gather():
+    """Dequantize-before-gather: the gather moves float-width rows, so
+    the bytes the store compressed away are re-paid on every probe —
+    caught by the wire-priced gather bound, invisible to the
+    element-denominated budget (element counts are identical)."""
+
+    def deq_then_gather(idx, store_f32):
+        return jnp.take(store_f32, idx, axis=0)
+
+    lowered = jax.jit(deq_then_gather).lower(
+        jnp.zeros((8, 2), jnp.int32),
+        jnp.zeros((16, 64, 32), jnp.float32),
+    )
+    texts = lowering.hlo_texts(lowered)
+    # the wire budget for the same probe at int8 lanes (2× headroom)
+    budget = 2 * 8 * 2 * 64 * 32 * 1
+    ctx = _quant_ctx(quant_gather_bytes=budget)
+    findings, _ = engine.run_rules(texts, ctx, _rules("R2-memory"))
+    assert any("quantized wire budget" in f.message for f in findings)
+
+    def code_gather(idx, store_s8):
+        return jnp.take(store_s8, idx, axis=0)
+
+    lowered2 = jax.jit(code_gather).lower(
+        jnp.zeros((8, 2), jnp.int32),
+        jnp.zeros((16, 64, 32), jnp.int8),
+    )
+    findings2, _ = engine.run_rules(
+        lowering.hlo_texts(lowered2), ctx, _rules("R2-memory")
+    )
+    assert not [f for f in findings2 if "wire budget" in f.message]
+
+
+def test_r4_quant_flags_float_width_rotation_and_missing_scale_permute():
+    """A float-width block rotating under an int8 label: the payload
+    check prices every permute at the wire dtype, and the quantized
+    permute count (3 per direction: codes + scales + ids) catches a
+    dropped scale permute."""
+    texts, cfg, meta = lowering.lower_target(
+        lowering.LintTarget("ring-overlap", "l2", "float32", "mixed")
+    )
+    ring_n = meta["ring_n"]
+    c_shard = 256 // ring_n  # LINT_M_MIXED rows over the ring
+    bad_meta = {
+        **meta,
+        "quantized": True,
+        # the int8 wire budget for this block; the f32 lowering's block
+        # permute is 4× over it
+        "permute_bytes_budget": c_shard * lowering.LINT_D,
+        # the quantized schedule rotates three arrays; the f32 lowering
+        # has two — a missing scale permute is a finding, not a pass
+        "expected_permutes": 3,
+    }
+    ctx = engine.LintContext(
+        target=lowering.LintTarget(
+            "ring-overlap", "l2", "float32", "mixed", quant="xfer-int8"
+        ),
+        cfg=cfg,
+        meta=bad_meta,
+    )
+    findings, _ = engine.run_rules(texts, ctx, _rules("R4-collective"))
+    assert any("wire-dtype budget" in f.message for f in findings)
+    assert any("expected exactly 3" in f.message for f in findings)

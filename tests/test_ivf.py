@@ -426,6 +426,10 @@ def test_cli_refusals_exit_2(tmp_path, rng):
     ) == 2
     assert serve_cli.main(
         ["--data", "synthetic:256x16c4", "--index-load", path,
+         "--ring-transfer-dtype", "int8", "--synthetic", "8"]
+    ) == 2
+    assert serve_cli.main(
+        ["--data", "synthetic:256x16c4", "--index-load", path,
          "--dtype", "bfloat16", "--synthetic", "8"]
     ) == 2
     # --nprobe without a clustered index is a silently-ignored knob: refuse
@@ -627,9 +631,21 @@ def test_default_ivf_lint_cells_are_clean():
     from mpi_knn_tpu.analysis import engine, lowering
 
     targets = [t for t in lowering.default_targets() if t.backend == "ivf"]
-    assert len(targets) == 6, targets
-    assert sorted(t.ladder for t in targets) == [
+    plain = [t for t in targets if not t.quant]
+    assert len(plain) == 6, targets
+    assert sorted(t.ladder for t in plain) == [
         "", "", "", "", "bucket", "nprobe",
+    ]
+    # the quantized at-rest cells (ISSUE 9): int8 one-shot × both
+    # policies, int4 one-shot, int8 mixed serve — certified in depth by
+    # tests/test_quant.py and the named check.sh gate; here they ride the
+    # same positive sweep
+    assert sorted((t.quant, t.policy, t.serve) for t in targets
+                  if t.quant) == [
+        ("int4", "exact", False),
+        ("int8", "exact", False),
+        ("int8", "mixed", False),
+        ("int8", "mixed", True),
     ]
     for t in targets:
         res = engine.lint_target(t)

@@ -661,9 +661,15 @@ def test_default_sharded_lint_cells_are_clean():
         t for t in lowering.default_targets()
         if t.backend == "ivf-sharded"
     ]
-    assert len(targets) == 5, targets
-    assert sorted(t.ladder for t in targets) == [
+    plain = [t for t in targets if not t.quant]
+    assert len(plain) == 5, targets
+    assert sorted(t.ladder for t in plain) == [
         "", "", "", "", "nprobe",
+    ]
+    # plus the quantized-exchange cells (ISSUE 9: rows ride the
+    # all-to-alls as int8 code lanes + a fifth scales collective)
+    assert sorted((t.quant, t.serve) for t in targets if t.quant) == [
+        ("int8", False), ("int8", True),
     ]
     for t in targets:
         res = engine.lint_target(t)
