@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
                    "per-batch programs the executable cache compiles, "
                    "whose donation/aliasing and no-corpus-copy contract "
                    "R5 certifies)")
+    p.add_argument("--frontend", action="store_true",
+                   help="restrict to the serving-front-end cells (the "
+                   "coalesced-dispatch program: a multi-tenant batch "
+                   "formed by the production coalescer, which must "
+                   "compile exactly an existing serve-grid bucket — no "
+                   "new programs — with R1–R5 re-certified on it)")
     p.add_argument("--quant", action="append", choices=list(LINT_QUANTS),
                    help="restrict to quantized cells: xfer-int8 (the "
                    "block-scaled int8 ring transfer — R3's quant/dequant "
@@ -103,6 +109,7 @@ def main(argv=None) -> int:
         and (not args.schedule or t.schedule in args.schedule)
         and (not args.quant or t.quant in args.quant)
         and (t.serve or not args.serve)
+        and (t.frontend or not args.frontend)
     ]
     if not targets:
         print("error: no targets match the given filters", file=sys.stderr)

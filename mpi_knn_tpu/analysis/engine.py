@@ -54,6 +54,7 @@ class TargetResult:
 
     def to_json(self) -> dict:
         return {
+            "label": self.target.label,
             "backend": self.target.backend,
             "metric": self.target.metric,
             "dtype": self.target.dtype,
@@ -61,6 +62,8 @@ class TargetResult:
             "schedule": self.target.schedule,
             "quant": self.target.quant,
             "serve": self.target.serve,
+            "ladder": self.target.ladder,
+            "frontend": self.target.frontend,
             "ok": self.ok,
             "skipped": self.skipped,
             "rules_run": self.rules_run,

@@ -78,6 +78,14 @@ class LintTarget:
     serve: bool = False
     ladder: str = ""  # "" | "bucket" | "nprobe" — serve cells only
     quant: str = ""  # "" | "xfer-int8" (ring) | "int8" | "int4" (at-rest)
+    # frontend=True (serve cells only): the batch is formed by the
+    # serving front end's PRODUCTION coalescer (multi-tenant requests,
+    # round-robin drain — mpi_knn_tpu.frontend.coalesce) before lowering
+    # through lower_bucket, certifying that coalesced dispatch compiles
+    # exactly a cell of the existing bucket grid: the front end adds NO
+    # new programs, only fills existing buckets (R1–R5 re-certify on
+    # what it fills)
+    frontend: bool = False
 
     @property
     def label(self) -> str:
@@ -92,6 +100,8 @@ class LintTarget:
             base = f"{base}/serve"
         if self.ladder:
             base = f"{base}/ladder-{self.ladder}"
+        if self.frontend:
+            base = f"{base}/frontend"
         return base
 
 
@@ -180,6 +190,17 @@ def default_targets() -> list[LintTarget]:
         # finding), with R5's donation contract intact on degraded cells
         LintTarget("ivf-sharded", "l2", "float32", serve=True,
                    ladder="nprobe"),
+    ] + [
+        # the serving FRONT END's hot path (ISSUE 11): a coalesced
+        # multi-tenant batch formed by the production Coalescer
+        # (mpi_knn_tpu.frontend), lowered through the SAME production
+        # lower_bucket as every serve cell. The cell's claim is that
+        # coalescing adds no new programs — the coalesced batch compiles
+        # exactly the serve grid cell its row count buckets to (asserted
+        # in the lowering: formed rows == the bucket the serve cell
+        # lints) — with R5's donation and R1–R4 re-certified on what
+        # coalesced dispatch actually compiles
+        LintTarget("serial", "l2", "float32", serve=True, frontend=True),
     ] + [
         # the QUANTIZED cells (ISSUE 9). Ring transfer at int8 — mixed
         # policy only (config.py refuses exact): R3 certifies the
@@ -771,6 +792,36 @@ def _lower_serve(target: LintTarget):
     )
     m = _lint_m(target)
     index = build_index(np.zeros((m, LINT_D), np.float32), cfg)
+    frontend_meta = {}
+    if target.frontend:
+        # the front-end cell: the batch is formed by the PRODUCTION
+        # coalescer — four tenant streams round-robined into one fill-
+        # triggered batch — and the bucket lowered is the one THAT batch
+        # selects. The no-new-programs contract is checked right here:
+        # the coalesced batch must land on exactly the serve cell's
+        # bucket (a mismatch means the front end would compile a program
+        # the plain serve matrix never certified — a hard failure, not a
+        # skip)
+        from mpi_knn_tpu.frontend.coalesce import Coalescer
+        from mpi_knn_tpu.serve.engine import bucket_rows
+
+        co = Coalescer(max_batch_rows=bucket, max_wait_s=0.001)
+        for i in range(4):
+            co.admit(f"tenant-{i}", None, bucket // 4, now=0.0)
+        cb = co.pop_ready(now=0.0)
+        if cb is None or bucket_rows(cb.rows, cfg.query_bucket) != bucket:
+            raise AssertionError(
+                "front-end coalescing selected a bucket outside the "
+                f"serve grid: coalesced {getattr(cb, 'rows', None)} rows "
+                f"vs expected bucket {bucket} — the no-new-programs "
+                "contract is broken"
+            )
+        frontend_meta = {
+            "frontend": True,
+            "coalesced_rows": cb.rows,
+            "coalesced_requests": len(cb.parts),
+            "coalesced_tenants": len(cb.tenants),
+        }
     lowered, q_pad, q_tile = lower_bucket(index, index.cfg, bucket)
     meta = {
         "q_tile": q_tile,
@@ -782,6 +833,7 @@ def _lower_serve(target: LintTarget):
         "donated_params": SCRATCH_PARAMS if index.cfg.donate else (),
         "resident_bytes": serve_resident_bytes(index),
         **_mixed_meta(target, q_tile, index.c_tile),
+        **frontend_meta,
     }
     if target.backend in RING_BACKENDS:
         ring_n = index.ring_meta[3]

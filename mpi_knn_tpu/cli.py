@@ -281,6 +281,19 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.obs.cli import main as metrics_main
 
         return metrics_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # serving front-end subcommand: async request coalescing + SLO
+        # admission over a ServeSession behind a thin multi-tenant HTTP
+        # server (mpi_knn_tpu.frontend). Same routing pattern as query.
+        from mpi_knn_tpu.frontend.cli import serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # open-loop multi-tenant load generator against a running
+        # `mpi-knn serve` — throughput-vs-p50/p99 rows (jax-free client).
+        from mpi_knn_tpu.frontend.cli import loadgen_main
+
+        return loadgen_main(argv[1:])
     if argv and argv[0] == "doctor":
         # preflight device-health subcommand: tiny jit + device_sync in a
         # heartbeat-supervised subprocess (mpi_knn_tpu.resilience), JSON

@@ -1,0 +1,67 @@
+"""Serving front end — the async layer above ``ServeSession`` (ISSUE 11):
+request coalescing, SLO-aware admission, queue-driven degradation, and a
+thin multi-tenant HTTP shell with an open-loop load generator.
+
+Layout::
+
+    coalesce.py    pure deterministic batcher (per-tenant FIFO, fill-or-
+                   deadline formation, deadline-first round-robin drain)
+    scheduler.py   SLO admission (queue-depth/rate 429s), overload
+                   shed/recover wired to the resilience ladder
+    server.py      the only impure parts: dispatch pump thread + stdlib
+                   HTTP server (POST /query, GET /metrics, GET /healthz)
+    loadgen.py     open-loop multi-tenant load generation (in-process
+                   and HTTP transports), throughput-vs-p99 rows
+    cli.py         `mpi-knn serve` / `mpi-knn loadgen`
+
+Public surface::
+
+    from mpi_knn_tpu.frontend import (
+        Coalescer, SLOPolicy, FrontendScheduler, Rejection,
+        Frontend, FrontendHTTPServer,
+    )
+
+Like ``resilience`` and ``obs``, the package is import-lazy (PEP 562)
+and jax-free at module load: the pure machinery (coalescer, scheduler,
+loadgen) runs in processes that never touch a device; only a bound
+``ServeSession`` brings jax with it.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "Coalescer": ("mpi_knn_tpu.frontend.coalesce", "Coalescer"),
+    "CoalescedBatch": ("mpi_knn_tpu.frontend.coalesce", "CoalescedBatch"),
+    "FrontendRequest": ("mpi_knn_tpu.frontend.coalesce", "FrontendRequest"),
+    "SLOPolicy": ("mpi_knn_tpu.frontend.scheduler", "SLOPolicy"),
+    "Rejection": ("mpi_knn_tpu.frontend.scheduler", "Rejection"),
+    "FrontendScheduler": (
+        "mpi_knn_tpu.frontend.scheduler", "FrontendScheduler"
+    ),
+    "Frontend": ("mpi_knn_tpu.frontend.server", "Frontend"),
+    "FrontendHTTPServer": (
+        "mpi_knn_tpu.frontend.server", "FrontendHTTPServer"
+    ),
+    "Ticket": ("mpi_knn_tpu.frontend.server", "Ticket"),
+    "loadgen": ("mpi_knn_tpu.frontend", "loadgen"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    if name == "loadgen":
+        return importlib.import_module("mpi_knn_tpu.frontend.loadgen")
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return __all__

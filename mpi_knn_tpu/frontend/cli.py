@@ -1,0 +1,340 @@
+"""``mpi-knn serve`` and ``mpi-knn loadgen`` — the network front end and
+its load generator.
+
+``serve`` builds a device-resident index over ``--data`` (the run
+driver's corpus specs), wraps a :class:`~mpi_knn_tpu.serve.ServeSession`
+in the coalescing front end, and listens on a loopback (or given) HTTP
+port: ``POST /query`` (JSON or raw f32 rows, ``X-Tenant`` header),
+``GET /metrics`` (Prometheus exposition), ``GET /healthz``. ``--port 0``
+binds an ephemeral port; ``--ready-file`` writes the final URL once the
+server is listening (the CI gate's rendezvous — parsing a log for a port
+number is a race, a file appearing is not).
+
+``loadgen`` drives a running server with open-loop multi-tenant load and
+prints/writes the throughput-vs-p50/p99 rows (``frontend/loadgen.py``;
+``--sweep`` runs several offered-QPS levels).
+
+Usage error convention as everywhere: combinations the stack cannot
+honor exit 2 loudly.
+
+Examples::
+
+    mpi-knn serve --data sift:100000 --k 10 --bucket 512 --port 8080
+    mpi-knn serve --data synthetic:8192x64c10 --port 0 \
+        --ready-file /tmp/knn.url --flight-record flight.jsonl
+    mpi-knn loadgen --url http://127.0.0.1:8080 --tenants 8 \
+        --qps 50 --requests 40 --rows 16 --report curve.json
+    mpi-knn loadgen --url http://127.0.0.1:8080 --sweep 10,50,200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+from mpi_knn_tpu.config import (
+    BACKENDS,
+    PRECISION_POLICIES,
+    KNNConfig,
+)
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn serve",
+        description="multi-tenant HTTP serving front end: async request "
+        "coalescing into the bucketed AOT executable cache, SLO-aware "
+        "admission, queue-driven degradation ladder",
+    )
+    d = p.add_argument_group("data / index")
+    d.add_argument("--data", default="mnist",
+                   help="corpus spec (run-driver forms: 'mnist', 'digits', "
+                   "'synthetic:MxDcC', 'sift:M', *.fvecs/bvecs, .mat)")
+    d.add_argument("--limit", type=int, default=None)
+    d.add_argument("--k", type=int, default=30)
+    d.add_argument("--backend", choices=BACKENDS, default="auto")
+    d.add_argument("--devices", type=int, default=None,
+                   help="ring size for distributed backends")
+    d.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16", "float64"])
+    d.add_argument("--query-tile", type=int, default=1024)
+    d.add_argument("--corpus-tile", type=int, default=2048)
+    d.add_argument("--precision-policy", choices=list(PRECISION_POLICIES),
+                   default="exact")
+    d.add_argument("--bucket", type=int, default=1024,
+                   help="base row bucket of the executable cache; batches "
+                   "pad to bucket*2^j rows")
+    d.add_argument("--dispatch-depth", type=int, default=2)
+
+    f = p.add_argument_group("front end (coalescing / SLO)")
+    f.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="coalescing deadline: no request waits longer "
+                   "than this for co-travelers before its batch "
+                   "dispatches ragged")
+    f.add_argument("--max-batch-rows", type=int, default=None,
+                   help="coalesced batch row target (default: --bucket, "
+                   "so steady-state fill batches land in one executable)")
+    f.add_argument("--max-queue-rows", type=int, default=8192,
+                   help="per-tenant queued-row ceiling; beyond it "
+                   "requests are refused with a structured 429")
+    f.add_argument("--tenant-qps", type=float, default=None,
+                   help="per-tenant admission rate limit (token bucket "
+                   "of --burst); default unlimited")
+    f.add_argument("--burst", type=int, default=32)
+    f.add_argument("--shed-queue-rows", type=int, default=None,
+                   help="total queued rows that, sustained for "
+                   "--shed-hold-ms, walk the serving degradation ladder "
+                   "one rung down (recovery restores it); default: never "
+                   "shed")
+    f.add_argument("--shed-hold-ms", type=float, default=50.0)
+    f.add_argument("--recover-hold-ms", type=float, default=250.0)
+
+    n = p.add_argument_group("network / output")
+    n.add_argument("--host", default="127.0.0.1")
+    n.add_argument("--port", type=int, default=8080,
+                   help="0 = ephemeral (printed, and written to "
+                   "--ready-file)")
+    n.add_argument("--request-timeout-s", type=float, default=30.0)
+    n.add_argument("--ready-file", default=None, metavar="PATH",
+                   help="write the listening URL here once ready (script "
+                   "rendezvous)")
+    n.add_argument("--flight-record", default=None, metavar="JSONL",
+                   help="span flight record (coalesce events, batch "
+                   "spans with tenant composition, shed/restore walks)")
+    n.add_argument("--metrics-out", default=None, metavar="JSON",
+                   help="write the metrics-registry snapshot at shutdown")
+    n.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                   default="auto")
+    n.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def serve_main(argv=None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    if args.max_wait_ms < 0:
+        print("error: --max-wait-ms must be >= 0", file=sys.stderr)
+        return 2
+    if args.port < 0:
+        print("error: --port must be >= 0", file=sys.stderr)
+        return 2
+    if args.recover_hold_ms < 0 or args.shed_hold_ms < 0:
+        print("error: hold times must be >= 0", file=sys.stderr)
+        return 2
+    if args.shed_queue_rows is None and (
+        args.shed_hold_ms != 50.0 or args.recover_hold_ms != 250.0
+    ):
+        # the serve-CLI inert-knob convention: hold times only matter
+        # once a shed threshold exists
+        print("error: --shed-hold-ms/--recover-hold-ms without "
+              "--shed-queue-rows: no shed threshold is set, so the "
+              "knobs would be silently inert", file=sys.stderr)
+        return 2
+
+    if args.flight_record:
+        from mpi_knn_tpu.obs.spans import FlightRecorder, set_recorder
+
+        set_recorder(FlightRecorder(args.flight_record, fresh=True))
+
+    if args.platform != "auto":
+        from mpi_knn_tpu.utils.platform import force_platform
+
+        force_platform(
+            args.platform,
+            n_devices=(args.devices if args.platform == "cpu" else None),
+        )
+
+    from mpi_knn_tpu.cli import load_corpus
+    from mpi_knn_tpu.frontend.scheduler import SLOPolicy
+    from mpi_knn_tpu.frontend.server import Frontend, FrontendHTTPServer
+    from mpi_knn_tpu.resilience import ResiliencePolicy
+    from mpi_knn_tpu.serve import ServeSession, build_index
+
+    X, _, source = load_corpus(args.data, limit=args.limit)
+    try:
+        cfg = KNNConfig(
+            k=args.k,
+            backend=args.backend,
+            dtype=args.dtype,
+            query_tile=args.query_tile,
+            corpus_tile=args.corpus_tile,
+            precision_policy=args.precision_policy,
+            num_devices=args.devices,
+            query_bucket=args.bucket,
+            dispatch_depth=args.dispatch_depth,
+        )
+        policy = SLOPolicy(
+            max_batch_rows=args.max_batch_rows or args.bucket,
+            max_wait_s=args.max_wait_ms / 1e3,
+            max_queue_rows=max(
+                args.max_queue_rows, args.max_batch_rows or args.bucket
+            ),
+            max_tenant_qps=args.tenant_qps,
+            burst=args.burst,
+            shed_queue_rows=args.shed_queue_rows,
+            shed_hold_s=args.shed_hold_ms / 1e3,
+            recover_hold_s=args.recover_hold_ms / 1e3,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    t0 = time.perf_counter()
+    try:
+        index = build_index(X, cfg)
+        # a ResiliencePolicy (even the default) builds the degradation
+        # ladder the queue-driven shed walks; without one the session
+        # would have only its full rung
+        session = ServeSession(index, resilience=ResiliencePolicy())
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    frontend = Frontend(session, policy)
+    frontend.start(warm_sizes=[policy.max_batch_rows])
+    server = FrontendHTTPServer(
+        frontend, host=args.host, port=args.port,
+        request_timeout_s=args.request_timeout_s, quiet=args.quiet,
+    ).start()
+    build_s = time.perf_counter() - t0
+    if not args.quiet:
+        print(
+            f"[mpi-knn serve] {source} shape={list(X.shape)} "
+            f"backend={index.backend} k={cfg.k} bucket={cfg.query_bucket} "
+            f"max_wait={args.max_wait_ms}ms (index+warm {build_s:.2f}s)"
+        )
+        print(f"[mpi-knn serve] listening on {server.url}", flush=True)
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(server.url + "\n")
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.stop()
+        frontend.stop()
+        if args.metrics_out:
+            from mpi_knn_tpu.obs.metrics import get_registry
+
+            with open(args.metrics_out, "w") as f:
+                json.dump(get_registry().snapshot(), f, indent=1)
+                f.write("\n")
+        if not args.quiet:
+            st = frontend.stats()
+            print(
+                f"[mpi-knn serve] shutdown: {st['queries_served']} query "
+                f"rows in {st['batches_retired']} batches, "
+                f"{st['rejected']} rejected, rung={st['rung']}"
+            )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_loadgen_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn loadgen",
+        description="open-loop multi-tenant load generator for a running "
+        "`mpi-knn serve` (throughput-vs-p50/p99 rows; open loop so an "
+        "overloaded server shows growing latency, not a slowing client)",
+    )
+    p.add_argument("--url", required=True,
+                   help="server base URL (e.g. http://127.0.0.1:8080)")
+    p.add_argument("--tenants", type=int, default=4,
+                   help="concurrent tenant streams")
+    p.add_argument("--qps", type=float, default=20.0,
+                   help="offered request rate PER TENANT stream")
+    p.add_argument("--sweep", default=None, metavar="Q1,Q2,...",
+                   help="sweep these offered per-tenant QPS levels "
+                   "instead of the single --qps")
+    p.add_argument("--requests", type=int, default=20,
+                   help="requests per tenant per level")
+    p.add_argument("--rows", type=int, default=16,
+                   help="query rows per request")
+    p.add_argument("--timeout-s", type=float, default=30.0)
+    p.add_argument("--report", default=None, help="write JSON rows here")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def loadgen_main(argv=None) -> int:
+    args = build_loadgen_parser().parse_args(argv)
+    if args.tenants < 1 or args.requests < 1 or args.rows < 1:
+        print("error: --tenants/--requests/--rows must be >= 1",
+              file=sys.stderr)
+        return 2
+    if args.qps <= 0:
+        print("error: --qps must be > 0", file=sys.stderr)
+        return 2
+    levels = [args.qps]
+    if args.sweep:
+        try:
+            levels = [float(v) for v in args.sweep.split(",") if v.strip()]
+        except ValueError:
+            levels = []
+        if not levels or any(v <= 0 for v in levels):
+            print(f"error: bad --sweep {args.sweep!r}: want a "
+                  "comma-separated list of positive QPS levels",
+                  file=sys.stderr)
+            return 2
+
+    from mpi_knn_tpu.frontend import loadgen
+
+    try:
+        health = loadgen.probe_server(args.url, timeout_s=args.timeout_s)
+    except OSError as e:
+        print(f"error: cannot reach {args.url}: {e}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(
+            f"[mpi-knn loadgen] {args.url}: backend={health['backend']} "
+            f"dim={health['dim']} k={health['k']} "
+            f"max_batch_rows={health['max_batch_rows']}"
+        )
+    rows_out = []
+    for qps in sorted(levels):
+        rep = loadgen.run_http(
+            args.url, tenants=args.tenants, qps=qps,
+            n_requests=args.requests, rows=args.rows,
+            timeout_s=args.timeout_s,
+        )
+        rows_out.append(rep)
+        if not args.quiet:
+            print(
+                f"  offered {rep['offered_qps_total']:g} req/s "
+                f"({args.tenants} tenants): achieved "
+                f"{rep['achieved_rps']} req/s "
+                f"({rep['achieved_qps_rows']} rows/s), "
+                f"p50 {rep['p50_ms']}ms p99 {rep['p99_ms']}ms, "
+                f"rejected {rep['rejected']}, errors {rep['errors']}"
+            )
+    if any(r["errors"] for r in rows_out):
+        print("error: load run saw serving errors (not 200/429)",
+              file=sys.stderr)
+        return 1
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump({
+                "schema": "mpi_knn_tpu.frontend.loadgen/1",
+                "url": args.url,
+                "health": health,
+                "rows": rows_out,
+            }, f, indent=1)
+            f.write("\n")
+        if not args.quiet:
+            print(f"report written to {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

@@ -1,0 +1,309 @@
+"""Open-loop multi-tenant load generation — throughput-vs-latency curves
+for the serving front end.
+
+Open loop is the honest protocol for a throughput-vs-p99 curve: each
+tenant stream issues requests on a FIXED arrival schedule (request i of a
+``qps``-rate stream is due at ``i / qps``), never waiting for responses —
+so when the server falls behind, latency GROWS instead of the generator
+politely slowing down to match (the closed-loop coordination artifact
+that makes overloaded servers look fine). Per-request latency is measured
+from the request's SCHEDULED arrival to completion, so queueing delay —
+including the generator itself getting behind schedule — is inside the
+number, not hidden beside it.
+
+Two transports, one report shape:
+
+- :func:`run_inprocess` drives a :class:`~mpi_knn_tpu.frontend.server.
+  Frontend` directly (no sockets): ``submit`` is a non-blocking enqueue,
+  so ONE thread per tenant sustains true open-loop arrivals, and the
+  pump's ticket fulfillment stamps completion times. This is what
+  ``scripts/bench_ops.py`` and the acceptance tests use.
+- :func:`run_http` drives a running server over HTTP (stdlib urllib,
+  one worker thread per in-flight request) — the ``mpi-knn loadgen``
+  CLI, exercising the full network path in the CI gate.
+
+:func:`run_sequential_baseline` is the comparison anchor: the same
+requests served one at a time at dispatch depth 1 (each lone request
+padding to its own bucket) — the "no front end" number the coalesced
+curve must beat (ISSUE 11 acceptance: ≥ 2× at an equal p99 bound).
+
+Report row shape (both transports)::
+
+    {tenants, offered_qps_per_tenant, offered_qps_total, requests,
+     rows_per_request, wall_s, achieved_qps_rows, achieved_rps,
+     p50_ms, p99_ms, rejected, errors, per_tenant: {t: served}}
+
+No jax import at module load.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from mpi_knn_tpu.frontend.scheduler import Rejection
+
+
+def synth_queries(dim: int, rows: int, *, lo: float = 0.0, hi: float = 1.0,
+                  seed: int = 0):
+    """One synthetic request payload (uniform in the corpus range — the
+    serve CLI's synthetic-stream convention)."""
+    rng = np.random.default_rng(seed)
+    return rng.uniform(lo, hi, size=(rows, dim)).astype(np.float32)
+
+
+def _percentiles_ms(lat_s: list) -> tuple:
+    if not lat_s:
+        return None, None
+    a = np.asarray(lat_s)
+    return (
+        round(float(np.percentile(a, 50)) * 1e3, 3),
+        round(float(np.percentile(a, 99)) * 1e3, 3),
+    )
+
+
+def _report(*, tenants, qps, rows, n_requests, wall_s, lat_s, rejected,
+            errors, served_rows, per_tenant) -> dict:
+    p50, p99 = _percentiles_ms(lat_s)
+    return {
+        "tenants": tenants,
+        "offered_qps_per_tenant": qps,
+        "offered_qps_total": round(qps * tenants, 3),
+        "requests": n_requests,
+        "rows_per_request": rows,
+        "wall_s": round(wall_s, 4),
+        "achieved_rps": round(len(lat_s) / wall_s, 2) if wall_s > 0 else None,
+        "achieved_qps_rows": round(served_rows / wall_s, 1)
+        if wall_s > 0 else None,
+        "p50_ms": p50,
+        "p99_ms": p99,
+        "rejected": rejected,
+        "errors": errors,
+        "per_tenant": dict(sorted(per_tenant.items())),
+    }
+
+
+# ---------------------------------------------------------------------------
+# in-process transport
+
+
+def run_inprocess(frontend, *, tenants: int, qps: float, n_requests: int,
+                  rows: int, lo: float = 0.0, hi: float = 1.0,
+                  seed: int = 0, timeout_s: float = 60.0) -> dict:
+    """Open-loop load against an in-process ``Frontend``: ``tenants``
+    streams × ``n_requests`` requests each at ``qps`` per stream.
+    Payloads are seeded per (tenant, request) so reruns offer identical
+    queries."""
+    dim = frontend.session.index.dim
+    t0 = time.monotonic()
+    tickets = []  # (tenant, scheduled_s, ticket-or-None(rejected))
+    lock = threading.Lock()
+
+    def stream(ti: int):
+        tenant = f"tenant-{ti}"
+        for i in range(n_requests):
+            due = t0 + i / qps
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            q = synth_queries(
+                dim, rows, lo=lo, hi=hi, seed=seed + ti * 100003 + i
+            )
+            out = frontend.submit(tenant, q)
+            with lock:
+                tickets.append(
+                    (tenant, due, None if isinstance(out, Rejection) else out)
+                )
+
+    threads = [
+        threading.Thread(target=stream, args=(ti,), daemon=True)
+        for ti in range(tenants)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    lat_s, rejected, errors, served_rows = [], 0, 0, 0
+    per_tenant: dict[str, int] = {}
+    deadline = time.monotonic() + timeout_s
+    for tenant, due, ticket in tickets:
+        if ticket is None:
+            rejected += 1
+            continue
+        try:
+            _, ids = ticket.result(timeout=max(0.0, deadline - time.monotonic()))
+        except Exception:
+            errors += 1
+            continue
+        lat_s.append(ticket.done_s - due)
+        served_rows += int(ids.shape[0])
+        per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+    wall = (
+        max(t.done_s for _, _, t in tickets if t is not None and t.done_s)
+        - t0
+        if any(t is not None and t.done_s for _, _, t in tickets)
+        else time.monotonic() - t0
+    )
+    return _report(
+        tenants=tenants, qps=qps, rows=rows, n_requests=n_requests,
+        wall_s=wall, lat_s=lat_s, rejected=rejected, errors=errors,
+        served_rows=served_rows, per_tenant=per_tenant,
+    )
+
+
+def run_sequential_baseline(session, *, tenants: int, n_requests: int,
+                            rows: int, lo: float = 0.0, hi: float = 1.0,
+                            seed: int = 0) -> dict:
+    """The no-front-end anchor: the SAME request population served one
+    request at a time, dispatch depth 1 (submit → retire before the next
+    request — per-stream sequential dispatch). Each lone request pads to
+    its own bucket, so the padded rows burned per request are exactly
+    what coalescing exists to reclaim. The caller passes a depth-1
+    session over the same index (``dispatch_depth=1``) so the comparison
+    isolates coalescing, not pipelining."""
+    dim = session.index.dim
+    lat_s, served_rows = [], 0
+    per_tenant: dict[str, int] = {}
+    t0 = time.monotonic()
+    for ti in range(tenants):
+        tenant = f"tenant-{ti}"
+        for i in range(n_requests):
+            q = synth_queries(
+                dim, rows, lo=lo, hi=hi, seed=seed + ti * 100003 + i
+            )
+            t1 = time.monotonic()
+            done = session.submit(q, tenants=((tenant, rows),))
+            done += session.drain()
+            lat_s.append(time.monotonic() - t1)
+            served_rows += sum(r.rows for r in done)
+            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+    wall = time.monotonic() - t0
+    return _report(
+        tenants=tenants, qps=float("inf"), rows=rows,
+        n_requests=n_requests, wall_s=wall, lat_s=lat_s, rejected=0,
+        errors=0, served_rows=served_rows, per_tenant=per_tenant,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport
+
+
+def probe_server(url: str, timeout_s: float = 10.0) -> dict:
+    """GET /healthz — the index facts (dim, k) a generator needs."""
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/healthz", timeout=timeout_s
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def fetch_metrics(url: str, timeout_s: float = 10.0) -> str:
+    """GET /metrics — the raw Prometheus exposition text."""
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/metrics", timeout=timeout_s
+    ) as resp:
+        return resp.read().decode()
+
+
+def _post_query(url: str, tenant: str, q: np.ndarray,
+                timeout_s: float) -> tuple:
+    """(status, rows_served): one POST /query round trip (raw f32 body —
+    no JSON float inflation on the wire)."""
+    req = urllib.request.Request(
+        url.rstrip("/") + "/query",
+        data=np.ascontiguousarray(q, dtype="<f4").tobytes(),
+        headers={
+            "Content-Type": "application/octet-stream",
+            "X-Tenant": tenant,
+        },
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+            return resp.status, int(doc.get("rows", 0))
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, 0
+    except (urllib.error.URLError, OSError, TimeoutError, ValueError):
+        # connection refused/reset, socket timeout, truncated body: the
+        # exact failures an OVERLOADED server produces — they must land
+        # in the report's error count, not kill the worker thread and
+        # vanish from achieved/p99 (a load tool that loses its failures
+        # under load flatters exactly what it exists to expose)
+        return 0, 0
+
+
+def run_http(url: str, *, tenants: int, qps: float, n_requests: int,
+             rows: int, lo: float = 0.0, hi: float = 1.0, seed: int = 0,
+             timeout_s: float = 30.0) -> dict:
+    """Open-loop load over HTTP: per tenant, an issuer thread fires one
+    worker thread per request at its scheduled arrival (workers carry the
+    blocking round trip so the schedule never waits on a response)."""
+    dim = int(probe_server(url)["dim"])
+    t0 = time.monotonic()
+    lock = threading.Lock()
+    lat_s: list[float] = []
+    stats = {"rejected": 0, "errors": 0, "served_rows": 0}
+    per_tenant: dict[str, int] = {}
+    workers: list[threading.Thread] = []
+
+    def fire(tenant: str, due: float, q) -> None:
+        status, served = _post_query(url, tenant, q, timeout_s)
+        done = time.monotonic()
+        with lock:
+            if status == 200:
+                lat_s.append(done - due)
+                stats["served_rows"] += served
+                per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+            elif status == 429:
+                stats["rejected"] += 1
+            else:
+                stats["errors"] += 1
+
+    def stream(ti: int):
+        tenant = f"tenant-{ti}"
+        for i in range(n_requests):
+            due = t0 + i / qps
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            q = synth_queries(
+                dim, rows, lo=lo, hi=hi, seed=seed + ti * 100003 + i
+            )
+            w = threading.Thread(
+                target=fire, args=(tenant, due, q), daemon=True
+            )
+            with lock:
+                workers.append(w)
+            w.start()
+
+    issuers = [
+        threading.Thread(target=stream, args=(ti,), daemon=True)
+        for ti in range(tenants)
+    ]
+    for th in issuers:
+        th.start()
+    for th in issuers:
+        th.join()
+    for w in list(workers):
+        w.join(timeout_s)
+    wall = time.monotonic() - t0
+    return _report(
+        tenants=tenants, qps=qps, rows=rows, n_requests=n_requests,
+        wall_s=wall, lat_s=lat_s, rejected=stats["rejected"],
+        errors=stats["errors"], served_rows=stats["served_rows"],
+        per_tenant=per_tenant,
+    )
+
+
+def sweep(run_one, qps_levels) -> list:
+    """Offered-QPS sweep: ``run_one(qps) -> report`` at each level —
+    the throughput-vs-p50/p99 curve, lowest load first."""
+    return [run_one(q) for q in sorted(qps_levels)]

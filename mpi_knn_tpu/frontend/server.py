@@ -1,0 +1,453 @@
+"""The thin shells around the pure front end: a threaded dispatch pump
+binding the scheduler to one ``ServeSession``, and a stdlib HTTP server.
+
+Layering (the testability contract): ``coalesce.py`` and ``scheduler.py``
+are pure state machines with injected clocks — everything with behavior
+worth asserting lives there and in the serve engine. THIS module only
+adds the two unavoidable impurities, each as thin as it can be made:
+
+- :class:`Frontend` — threads and real time: client threads enqueue
+  through ``submit`` (admission under one lock, O(µs)); ONE pump thread
+  polls the scheduler, stacks each coalesced batch, drives the session
+  (the session is single-threaded by design — the pump is its only
+  caller), and scatters retired results back to per-request tickets.
+- :class:`FrontendHTTPServer` — sockets: ``POST /query`` (JSON or raw
+  little-endian f32 rows, tenant id in ``X-Tenant``), ``GET /metrics``
+  (the obs Prometheus exposition, the exact text ``parse_prometheus``
+  re-parses in CI), ``GET /healthz`` (liveness + serving posture: rung,
+  queue, uptime — and the index facts a load generator needs to shape
+  requests). Handlers translate: 429 from a :class:`Rejection`, 400 from
+  malformed payloads, 200 with per-row results otherwise.
+
+Why one pump thread: the serve engine's dispatch-ahead pipeline
+(``dispatch_depth``) already provides the useful concurrency on the
+device side; a second submitting thread would only interleave
+``submit``/``drain`` nondeterministically. The pump wakes on new work
+(condition variable) or the oldest request's coalescing deadline —
+idle-spinning would burn a core, sleeping a fixed quantum would add it
+to every light-load latency.
+
+No jax import at module load (the session object carries everything).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from mpi_knn_tpu.frontend.scheduler import (
+    FrontendScheduler,
+    Rejection,
+    SLOPolicy,
+)
+from mpi_knn_tpu.obs import metrics as obs_metrics
+from mpi_knn_tpu.obs import spans as obs_spans
+
+
+class FrontendError(RuntimeError):
+    """The pump died (or the session raised) with requests outstanding;
+    carried to every waiting ticket so no client blocks forever."""
+
+
+class Ticket:
+    """One admitted request's rendezvous: the submitting thread waits on
+    ``result``; the pump fulfills (or fails) it at retire."""
+
+    __slots__ = ("request", "_event", "_dists", "_ids", "_error", "done_s")
+
+    def __init__(self, request):
+        self.request = request
+        self._event = threading.Event()
+        self._dists = None
+        self._ids = None
+        self._error = None
+        self.done_s = None  # time.monotonic() at fulfill (loadgen's clock)
+
+    def _fulfill(self, dists, ids) -> None:
+        self._dists, self._ids = dists, ids
+        self.done_s = time.monotonic()
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self.done_s = time.monotonic()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        """(dists, ids) for this request's rows — blocks until the
+        coalesced batch carrying it retires. Raises the serving error on
+        failure, TimeoutError on timeout."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request seq={self.request.seq} not served within "
+                f"{timeout}s (tenant={self.request.tenant!r})"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._dists, self._ids
+
+
+class Frontend:
+    """Bind a :class:`FrontendScheduler` to one ``ServeSession`` with a
+    dispatch pump thread. ``session`` should be constructed with a
+    ``ResiliencePolicy`` (even the default one) when shedding is wanted:
+    the degradation ladder is built at session construction, and a
+    policy-less session has only its full rung to serve."""
+
+    def __init__(self, session, policy: SLOPolicy,
+                 clock=time.monotonic):
+        self.session = session
+        self.policy = policy
+        self._clock = clock
+        self.scheduler = FrontendScheduler(
+            policy,
+            on_shed=lambda: session.shed_rung(reason="queue-overload"),
+            on_recover=lambda: session.restore_rung(
+                reason="queue-recovered"
+            ),
+        )
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._tickets: dict[int, Ticket] = {}  # request seq -> ticket
+        self._dispatched = []  # CoalescedBatch FIFO awaiting retire
+        self._stop = False
+        self._crashed: BaseException | None = None
+        self.started_s = time.monotonic()
+        self._pump = threading.Thread(
+            target=self._run, name="frontend-pump", daemon=True
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, warm_sizes=None) -> "Frontend":
+        """Start the pump; ``warm_sizes`` (row counts) pre-compiles those
+        buckets at EVERY ladder rung first, so neither the first batch
+        nor a shed rung ever cold-compiles into live traffic (default:
+        the policy's full batch target). One real zero-batch dispatch
+        then runs per size via the one-shot ``query_knn`` path: the
+        first dispatch pays jax's one-time dispatch-path setup
+        (~hundreds of ms) on top of the AOT cache, and that cost belongs
+        in startup, not in the first client's latency. ``query_knn``
+        shares the executables and dispatch machinery but feeds NO
+        session window stats and NO serving counters/histograms — the
+        warm-up is plumbing and must be invisible to /metrics, not
+        merely wiped from the session window."""
+        sizes = (
+            [self.policy.max_batch_rows] if warm_sizes is None
+            else list(warm_sizes)
+        )
+        if sizes:
+            from mpi_knn_tpu.serve.engine import query_knn
+
+            self.session.warm(sizes)
+            dim = self.session.index.dim
+            for n in sizes:
+                query_knn(
+                    np.zeros((n, dim), np.float32), self.session.index,
+                    self.session.cfg,
+                )
+        self._pump.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Flush: every admitted request is served before the pump exits
+        (admission stops immediately)."""
+        with self._lock:
+            self._stop = True
+            self._work.notify()
+        self._pump.join(timeout)
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, tenant: str, queries):
+        """Admit one request (non-blocking): a :class:`Ticket` to wait
+        on, or the scheduler's structured :class:`Rejection`."""
+        queries = np.ascontiguousarray(queries, dtype=np.float32)
+        if queries.ndim != 2:
+            raise ValueError(
+                f"queries must be (rows, dim), got shape {queries.shape}"
+            )
+        with self._lock:
+            if self._stop or self._crashed is not None:
+                return Rejection(
+                    tenant=str(tenant), reason="shutting-down",
+                    detail="front end is stopping", retry_after_s=0.0,
+                    status=503,
+                )
+            out = self.scheduler.submit(
+                tenant, queries, queries.shape[0], self._clock()
+            )
+            if isinstance(out, Rejection):
+                return out
+            ticket = Ticket(out)
+            self._tickets[out.seq] = ticket
+            self._work.notify()
+            return ticket
+
+    def stats(self) -> dict:
+        """The health/posture snapshot ``GET /healthz`` serves."""
+        ses = self.session
+        with self._lock:
+            return {
+                "ok": self._crashed is None,
+                "uptime_s": round(time.monotonic() - self.started_s, 3),
+                "queue_rows": self.scheduler.coalescer.pending_rows,
+                "queue_requests": self.scheduler.coalescer.pending_requests,
+                "admitted": self.scheduler.admitted,
+                "rejected": self.scheduler.rejected,
+                "rung": ses.rung,
+                "ladder": [label for label, _ in ses.ladder],
+                "sheds": len(self.scheduler.sheds),
+                "recoveries": len(self.scheduler.recoveries),
+                "batches_retired": len(ses.latencies),
+                "queries_served": ses.queries_served,
+                "tenants": sorted(ses.tenant_stats),
+                # what a load generator needs to shape requests
+                "dim": ses.index.dim,
+                "k": ses.cfg.k,
+                "backend": ses.index.backend,
+                "max_batch_rows": self.policy.max_batch_rows,
+            }
+
+    # -- pump -------------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    stopping = self._stop
+                    batches = self.scheduler.poll(
+                        self._clock(), flush=stopping
+                    )
+                for b in batches:
+                    self._dispatch(b)
+                if not batches:
+                    # nothing formed: retire in-flight work so results
+                    # are not held hostage to the NEXT batch arriving
+                    # (dispatch-ahead depth > 1 would otherwise strand
+                    # the last batch of a lull in the pipeline)
+                    if self._dispatched:
+                        for res in self.session.drain():
+                            self._scatter(res)
+                    with self._lock:
+                        if self._stop and not (
+                            self._dispatched
+                            or self.scheduler.coalescer.pending_rows
+                        ):
+                            return
+                        wake = self.scheduler.next_wake_s()
+                        timeout = (
+                            0.05 if wake is None
+                            else max(0.0, wake - self._clock())
+                        )
+                        if not self._stop:
+                            self._work.wait(timeout=min(timeout, 0.05))
+        except BaseException as e:  # noqa: BLE001 — fail tickets, re-raise
+            with self._lock:
+                self._crashed = e
+                err = FrontendError(
+                    f"frontend pump died: {type(e).__name__}: {e}"
+                )
+                for t in self._tickets.values():
+                    if not t.done():
+                        t._fail(err)
+                self._tickets.clear()
+                self._dispatched.clear()
+            raise
+
+    def _dispatch(self, batch) -> None:
+        q = np.concatenate([r.queries for r in batch.parts], axis=0)
+        self._metrics().histogram(
+            "frontend_batch_fill_rows",
+            help="coalesced rows per dispatched batch",
+            buckets=_FILL_BUCKETS,
+        ).observe(batch.rows)
+        self._metrics().counter(
+            "frontend_batches_total",
+            help="coalesced batches dispatched",
+            labels={"reason": batch.reason},
+        ).inc()
+        obs_spans.event(
+            "coalesce", cat="frontend", rows=batch.rows,
+            requests=len(batch.parts), reason=batch.reason,
+            oldest_wait_ms=round(batch.oldest_wait_s * 1e3, 3),
+        )
+        self._dispatched.append(batch)
+        for res in self.session.submit(q, tenants=batch.composition()):
+            self._scatter(res)
+
+    def _scatter(self, res) -> None:
+        batch = self._dispatched.pop(0)
+        dists, ids = res.dists, res.ids  # one D2H, padding stripped
+        with self._lock:
+            for req, start, stop in batch.slices():
+                t = self._tickets.pop(req.seq, None)
+                if t is not None:
+                    t._fulfill(dists[start:stop], ids[start:stop])
+
+    def _metrics(self):
+        return obs_metrics.get_registry()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+
+# fill histogram: powers of two around common bucket grids
+_FILL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+TENANT_HEADER = "X-Tenant"
+DEFAULT_TENANT = "default"
+
+
+def _http_handler(frontend: Frontend, request_timeout_s: float,
+                  quiet: bool = True):
+    """The BaseHTTPRequestHandler subclass bound to one frontend —
+    built by closure (stdlib handlers have no constructor channel)."""
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _json(self, status: int, doc: dict) -> None:
+            body = (json.dumps(doc) + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _text(self, status: int, text: str, ctype: str) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # noqa: A003
+            if not quiet:
+                BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+        def _read_queries(self):
+            """(rows, dim) f32 from the request body: JSON
+            ``{"queries": [[...], ...]}`` or raw little-endian f32 rows
+            at the index dim (``application/octet-stream``)."""
+            n = int(self.headers.get("Content-Length") or 0)
+            if n <= 0:
+                raise ValueError("empty request body")
+            raw = self.rfile.read(n)
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            dim = frontend.session.index.dim
+            if ctype == "application/octet-stream":
+                if len(raw) % (4 * dim):
+                    raise ValueError(
+                        f"raw f32 body of {len(raw)} bytes is not a "
+                        f"whole number of dim={dim} rows"
+                    )
+                return np.frombuffer(raw, dtype="<f4").reshape(-1, dim)
+            doc = json.loads(raw)
+            q = np.asarray(doc["queries"], dtype=np.float32)
+            if q.ndim != 2 or q.shape[1] != dim:
+                raise ValueError(
+                    f"queries shape {q.shape} does not match index "
+                    f"dim {dim}"
+                )
+            return q
+
+        def do_POST(self):  # noqa: N802 — stdlib handler convention
+            if self.path != "/query":
+                self._json(404, {"error": f"no such route {self.path}"})
+                return
+            tenant = self.headers.get(TENANT_HEADER, DEFAULT_TENANT)
+            try:
+                q = self._read_queries()
+            except (ValueError, KeyError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            out = frontend.submit(tenant, q)
+            if isinstance(out, Rejection):
+                self.send_response(out.status)
+                body = (json.dumps({
+                    "error": out.reason,
+                    "detail": out.detail,
+                    "tenant": out.tenant,
+                    "retry_after_s": out.retry_after_s,
+                }) + "\n").encode()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After",
+                                 str(max(0.0, out.retry_after_s)))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            try:
+                dists, ids = out.result(timeout=request_timeout_s)
+            except TimeoutError as e:
+                self._json(504, {"error": str(e)})
+                return
+            except Exception as e:  # serving error (sentinel, …)
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._json(200, {
+                "rows": int(ids.shape[0]),
+                "dists": [[float(v) for v in row] for row in dists],
+                "ids": ids.tolist(),
+            })
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/metrics":
+                self._text(
+                    200, obs_metrics.get_registry().to_prometheus(),
+                    "text/plain; version=0.0.4",
+                )
+            elif self.path == "/healthz":
+                st = frontend.stats()
+                self._json(200 if st["ok"] else 503, st)
+            else:
+                self._json(404, {"error": f"no such route {self.path}"})
+
+    return Handler
+
+
+class FrontendHTTPServer:
+    """``ThreadingHTTPServer`` wrapper: bind, serve in a thread, expose
+    the bound address (``--port 0`` picks an ephemeral port)."""
+
+    def __init__(self, frontend: Frontend, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 30.0,
+                 quiet: bool = True):
+        from http.server import ThreadingHTTPServer
+
+        self.frontend = frontend
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _http_handler(frontend, request_timeout_s, quiet)
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="frontend-http",
+            daemon=True,
+        )
+
+    @property
+    def address(self) -> tuple:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FrontendHTTPServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(10.0)

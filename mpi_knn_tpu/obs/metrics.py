@@ -23,6 +23,16 @@ Export: :meth:`MetricsRegistry.snapshot` is the JSON form;
 format, and :func:`parse_prometheus` is the strict re-parser the CI gate
 uses to prove the exposition is well-formed.
 
+Labels (the multi-tenant front end's axis): counters and gauges accept a
+``labels`` dict — the metric is registered under its canonical sample
+name (``name{key="value"}``, keys sorted), so every (name, labels)
+combination is its own monotonic series and the exposition emits one
+``HELP``/``TYPE`` header per base name. Histograms do NOT take labels:
+a labeled histogram's ``_bucket`` suffix belongs after the base name in
+the exposition (``name_bucket{le=...,tenant=...}``), which this
+registry's name-keyed storage cannot express — per-tenant latency lives
+in ``ServeSession.tenant_stats`` instead.
+
 No jax import at module load (the resilience supervisors import through
 here); jax is touched only inside :func:`install_jax_compile_listener`.
 """
@@ -44,6 +54,36 @@ DEFAULT_LATENCY_BUCKETS_S = (
 COMPILE_BUCKETS_S = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0)
 
 JAX_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _valid_metric_name(name: str) -> bool:
+    return bool(name) and not name[0].isdigit() and all(
+        c.isalnum() or c in "_:" for c in name
+    )
+
+
+def sample_name(name: str, labels: dict | None = None) -> str:
+    """The canonical exposition sample name for (name, labels):
+    ``name`` bare, or ``name{k="v",...}`` with keys sorted so the same
+    label set always produces the same registry key. Label values that
+    would need exposition escaping (quotes, backslashes, newlines) are
+    rejected loudly — a tenant id is an identifier, not free text."""
+    if not _valid_metric_name(name):
+        raise ValueError(f"bad metric name {name!r}")
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        if not _valid_metric_name(k) or ":" in k:
+            raise ValueError(f"bad label name {k!r} for metric {name!r}")
+        v = str(labels[k])
+        if any(c in v for c in ('"', "\\", "\n")):
+            raise ValueError(
+                f"label value {v!r} for {name}{{{k}}} needs escaping; "
+                "use plain identifier-like values"
+            )
+        parts.append(f'{k}="{v}"')
+    return name + "{" + ",".join(parts) + "}"
 
 
 class Counter:
@@ -192,13 +232,27 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
+        # base family name -> metric class: the kind-collision guard must
+        # key on the part BEFORE the label set, or a labeled counter and
+        # a bare gauge sharing one base would coexist and render a
+        # mixed-kind family under a single TYPE header (malformed
+        # exposition a real scraper mis-types)
+        self._kinds: dict[str, type] = {}
 
     def _get_or_create(self, cls, name, help, **kw):
+        base = name.split("{", 1)[0]
         with self._lock:
+            known = self._kinds.get(base)
+            if known is not None and known is not cls:
+                raise ValueError(
+                    f"metric family {base!r} already registered as "
+                    f"{known.kind}, requested {cls.kind}"
+                )
             m = self._metrics.get(name)
             if m is None:
                 m = cls(name, help=help, **kw)
                 self._metrics[name] = m
+                self._kinds[base] = cls
                 return m
         if not isinstance(m, cls):
             raise ValueError(
@@ -213,15 +267,27 @@ class MetricsRegistry:
             )
         return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labels: dict | None = None) -> Counter:
+        return self._get_or_create(Counter, sample_name(name, labels), help)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labels: dict | None = None) -> Gauge:
+        return self._get_or_create(Gauge, sample_name(name, labels), help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets=DEFAULT_LATENCY_BUCKETS_S) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets=DEFAULT_LATENCY_BUCKETS_S,
+                  labels: dict | None = None) -> Histogram:
+        if labels:
+            raise ValueError(
+                f"histogram {name!r}: labels are not supported (the "
+                "_bucket suffix belongs between the base name and the "
+                "label set, which name-keyed storage cannot express) — "
+                "keep per-label latency in caller state instead"
+            )
+        return self._get_or_create(
+            Histogram, sample_name(name), help, buckets=buckets
+        )
 
     def snapshot(self) -> dict:
         """JSON-able snapshot of every metric (sorted by name — the
@@ -241,6 +307,7 @@ class MetricsRegistry:
         window)."""
         with self._lock:
             self._metrics.clear()
+            self._kinds.clear()
 
 
 _default_registry = MetricsRegistry()
@@ -268,11 +335,18 @@ def to_prometheus(snapshot: dict) -> str:
     text exposition format (histograms as cumulative ``_bucket{le=...}``
     series plus ``_sum``/``_count``)."""
     out = []
+    # labeled series share one HELP/TYPE header per BASE name (the part
+    # before the label set) — duplicate TYPE lines for one metric family
+    # are malformed exposition
+    seen_bases: set[str] = set()
     for name, m in snapshot.get("metrics", {}).items():
         kind = m["kind"]
-        if m.get("help"):
-            out.append(f"# HELP {name} {m['help']}")
-        out.append(f"# TYPE {name} {kind}")
+        base = name.split("{", 1)[0]
+        if base not in seen_bases:
+            seen_bases.add(base)
+            if m.get("help"):
+                out.append(f"# HELP {base} {m['help']}")
+            out.append(f"# TYPE {base} {kind}")
         if kind in ("counter", "gauge"):
             out.append(f"{name} {_prom_num(m['value'])}")
         elif kind == "histogram":

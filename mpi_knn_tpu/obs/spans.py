@@ -62,6 +62,7 @@ RECORDER_ENV = "TKNN_FLIGHT_RECORD"
 
 SPAN_CATEGORIES = (
     "serve", "index", "compile", "bench", "retry", "heartbeat", "profile",
+    "frontend",
 )
 
 

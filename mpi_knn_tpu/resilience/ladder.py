@@ -26,6 +26,20 @@ rung's per-batch program is a normal (bucket, config) cell of the serve
 executable cache: compiled once, R5-donation-linted like any other serve
 cell (the lint matrix carries explicit ladder cells).
 
+Two things walk the ladder DOWN (``ServeSession.shed_rung``): the
+session's own per-batch deadline machinery (``degrade_after``
+consecutive breaches — overload measured at the batch), and the serving
+front end's SLO scheduler (``mpi_knn_tpu.frontend.scheduler`` —
+sustained coalescer queue growth, overload measured UPSTREAM of the
+batch, before latency ever breaches). Only the front end walks it back
+UP (``ServeSession.restore_rung``) once the queue has stayed drained:
+queue depth is a symmetric signal ("the overload has passed" is
+observable), a deadline breach is not. Both directions land in the
+metrics registry (``serve_degradations_total`` /
+``serve_restorations_total`` / the ``serve_ladder_rung`` gauge) and the
+span flight record (``degrade``/``restore`` events with the triggering
+reason), so a rung walk is always reconstructible after the fact.
+
 No jax import at module load (the policy/ladder types are used by
 supervisors too).
 """

@@ -161,6 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "loudly with batch provenance)")
 
     o = p.add_argument_group("output / observability (mpi_knn_tpu.obs)")
+    o.add_argument("--tenant", default=None, metavar="NAME",
+                   help="attribute this stream to a tenant id: per-tenant "
+                   "counters (serve_tenant_queries_total{tenant=...}) in "
+                   "the metrics registry, tenant composition on the batch "
+                   "flight spans, and a per-tenant block in --report — "
+                   "the single-stream form of the serving front end's "
+                   "multi-tenant accounting (mpi-knn serve)")
     o.add_argument("--report", default=None, help="write JSON report here")
     o.add_argument("--flight-record", default=None, metavar="JSONL",
                    help="record structured trace spans (index build, "
@@ -270,6 +277,16 @@ def main(argv=None) -> int:
         # a zero/negative stream would "succeed" with 0 queries served —
         # a silent no-op where the convention demands a loud usage error
         print("error: --synthetic must be >= 1", file=sys.stderr)
+        return 2
+    if args.tenant is not None and (
+        not args.tenant
+        or any(c in args.tenant for c in ('"', "\\", "\n", "\r"))
+    ):
+        # the tenant id becomes a metrics label: refuse at the flag, not
+        # with a mid-stream traceback at the first batch retire
+        print("error: --tenant must be non-empty with no quotes, "
+              "backslashes, or newlines (it becomes a metrics label)",
+              file=sys.stderr)
         return 2
 
     try:
@@ -493,7 +510,7 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
     t0 = time.perf_counter()
     n_batches = 0
     degraded_batches = 0
-    for res in session.stream(stream):
+    for res in session.stream(stream, tenant=args.tenant):
         n_batches += 1
         if res.degraded is not None:
             degraded_batches += 1
@@ -562,6 +579,23 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
                 cfg, index.corpus_sharded.shape[0], index.dim,
                 index.ring_meta[3],
             ),
+        }
+    if session.tenant_stats:
+        # the per-tenant window accumulators (first-class session state,
+        # never reconstructed from deltas of the global blob): rows,
+        # batches touched, latency sum/max — per tenant
+        summary["tenants"] = {
+            t: {
+                "queries": st["queries"],
+                "batches": st["batches"],
+                "latency_sum_ms": round(st["latency_sum_s"] * 1e3, 3),
+                "latency_max_ms": round(st["latency_max_s"] * 1e3, 3),
+                **(
+                    {"routed": round(st["routed"], 1)}
+                    if "routed" in st else {}
+                ),
+            }
+            for t, st in sorted(session.tenant_stats.items())
         }
     if session.exchange is not None:
         # the sharded candidate-exchange story, summarized where the

@@ -686,6 +686,12 @@ class BatchResult:
     retries: int = 0
     backoffs: tuple = ()
     deadline_breached: bool = False
+    # multi-tenant composition of a coalesced batch (the serving front
+    # end, mpi_knn_tpu.frontend): ((tenant, rows), ...) in row order,
+    # summing to ``rows``; None = an unattributed legacy batch. The
+    # session's per-tenant accumulators and the per-tenant registry
+    # counters are fed from this at retire.
+    tenants: tuple | None = None
     # sharded-clustered batches only: the device (N_STATS·S,) exchange
     # stats vector (routed/dropped/served per shard) + the executable's
     # static per-batch exchange bytes
@@ -793,9 +799,25 @@ class ServeSession:
     previous batch's device compute (double buffering). Latency per batch
     is dispatch→``device_sync`` — the honest number under async dispatch.
 
-    ``latencies``/``queries_served`` accumulate until ``reset_stats()``:
-    a long-lived server should reset per reporting window (one float per
-    batch adds up over millions of batches).
+    Sessions are REUSABLE across streams: ``stream``/``submit``+``drain``
+    may be called any number of times over one session, the executable
+    cache stays warm across streams (zero recompiles on the second
+    stream), and ``seq`` keeps counting monotonically so batch provenance
+    never aliases between streams. ``latencies``/``queries_served``/
+    ``tenant_stats``/``exchange`` accumulate until ``reset_stats()``: a
+    long-lived server should reset per reporting window (one float per
+    batch adds up over millions of batches). See ``reset_stats`` for the
+    exact window semantics (in-flight batches land in the NEW window).
+
+    Multi-tenant attribution (the serving front end's contract): a
+    coalesced batch submitted with ``tenants=((tenant, rows), ...)``
+    stamps its composition on the batch span and, at retire, feeds
+    ``tenant_stats`` — per tenant: served query rows, batches touched,
+    latency sum/max, and (sharded-clustered sessions) a rows-proportional
+    share of the routed candidate exchange — plus the labeled
+    ``serve_tenant_queries_total{tenant=...}`` registry counters, so
+    per-tenant reporting is first-class state, never reconstructed from
+    deltas of the global accumulators.
 
     With a :class:`~mpi_knn_tpu.resilience.ladder.ResiliencePolicy` the
     session additionally enforces a per-batch deadline (measured at
@@ -840,8 +862,13 @@ class ServeSession:
         self.latencies: list[float] = []
         self.queries_served = 0
         self.degradations: list[dict] = []  # rung-shed events, in order
+        self.restorations: list[dict] = []  # rung-restore events, in order
         self.retries_total = 0
         self.deadline_breaches = 0
+        # per-tenant window accumulators (fed by batches submitted with a
+        # ``tenants`` composition): tenant -> {queries, batches,
+        # latency_sum_s, latency_max_s[, routed]}
+        self.tenant_stats: dict[str, dict] = {}
         # sharded-clustered sessions accumulate the candidate-exchange
         # story (routed/dropped totals, static exchange bytes, per-shard
         # served-request load) for the CLI report; None elsewhere
@@ -878,12 +905,29 @@ class ServeSession:
                     )
 
     def reset_stats(self) -> None:
-        """Start a fresh measurement window (in-flight batches keep their
-        dispatch timestamps and will land in the new window)."""
+        """Start a fresh measurement window. The exact contract (tested
+        in ``tests/test_serve.py`` — the front end's per-tenant reporting
+        leans on it):
+
+        - resets the WINDOW accumulators: ``latencies``,
+          ``queries_served``, ``retries_total``, ``deadline_breaches``,
+          ``tenant_stats``, and the sharded ``exchange`` totals;
+        - does NOT reset serving identity or position: ``seq`` keeps
+          counting (batch provenance stays unique across windows), the
+          executable cache stays warm (a reset never costs a recompile),
+          and the ladder keeps its current rung with its
+          ``degradations``/``restorations`` history (shedding is a
+          serving condition, not a statistic of one window);
+        - in-flight batches keep their dispatch timestamps and land in
+          the NEW window at retire — a window boundary never drops or
+          double-counts a batch, it only decides which window's
+          percentile the batch feeds.
+        """
         self.latencies = []
         self.queries_served = 0
         self.retries_total = 0
         self.deadline_breaches = 0
+        self.tenant_stats = {}
         if self.exchange is not None:
             # the candidate-exchange story is part of the window: totals
             # spanning a warm-up batch would overstate routed volume
@@ -955,30 +999,74 @@ class ServeSession:
             "serve_deadline_breaches_total",
             help="batches whose dispatch→sync latency overran the deadline",
         ).inc()
-        if (
-            self._consecutive_breaches >= pol.degrade_after
-            and self._rung < len(self.ladder) - 1
-        ):
-            self._rung += 1
-            self._consecutive_breaches = 0
-            self.degradations.append({
-                "after_batch": res.seq,
-                "rung": self.ladder[self._rung][0],
-                "breaches": self.deadline_breaches,
-            })
-            obs_spans.event(
-                "degrade", cat="serve", after_batch=res.seq,
-                rung=self.ladder[self._rung][0],
-                breaches=self.deadline_breaches,
-            )
-            self._metrics.counter(
-                "serve_degradations_total",
-                help="ladder rungs shed on sustained deadline breach",
-            ).inc()
-            self._metrics.gauge(
-                "serve_ladder_rung",
-                help="current degradation-ladder rung index (0 = full)",
-            ).set(self._rung)
+        if self._consecutive_breaches >= pol.degrade_after:
+            self.shed_rung(reason="deadline-breach", after_batch=res.seq)
+
+    def shed_rung(self, *, reason: str = "deadline-breach",
+                  after_batch: int | None = None) -> str | None:
+        """Walk ONE rung down the degradation ladder, explicitly.
+
+        Two callers: the session's own deadline machinery
+        (``_note_latency``, on a breach streak) and the serving front
+        end's SLO scheduler (``mpi_knn_tpu.frontend.scheduler``, on
+        sustained queue growth — overload is visible upstream of the
+        per-batch latency there). Either way the event is recorded the
+        same: a ``degradations`` entry with the triggering ``reason``, a
+        ``degrade`` flight event, and the registry counter + rung gauge —
+        a rung walk is never invisible. Returns the new rung's label, or
+        None when already at the ladder floor (nothing shed)."""
+        if self._rung >= len(self.ladder) - 1:
+            return None
+        self._rung += 1
+        self._consecutive_breaches = 0
+        label = self.ladder[self._rung][0]
+        self.degradations.append({
+            "after_batch": after_batch if after_batch is not None
+            else max(0, self._seq - 1),
+            "rung": label,
+            "breaches": self.deadline_breaches,
+            "reason": reason,
+        })
+        obs_spans.event(
+            "degrade", cat="serve",
+            after_batch=self.degradations[-1]["after_batch"],
+            rung=label, breaches=self.deadline_breaches, reason=reason,
+        )
+        self._metrics.counter(
+            "serve_degradations_total",
+            help="ladder rungs shed (deadline breach or queue overload)",
+        ).inc()
+        self._metrics.gauge(
+            "serve_ladder_rung",
+            help="current degradation-ladder rung index (0 = full)",
+        ).set(self._rung)
+        return label
+
+    def restore_rung(self, *, reason: str = "recovered") -> str | None:
+        """Walk ONE rung back UP the ladder after the overload that shed
+        it has passed (the front end's recovery path; the deadline
+        machinery never restores — a breach-driven shed has no
+        symmetrical 'deadlines are comfortably met' signal, queue depth
+        does). Every rung on the way up is already compiled (``warm``
+        pre-compiles the whole ladder), so a restore can never cold-
+        compile into recovering traffic. Returns the restored rung's
+        label, or None when already serving the full rung."""
+        if self._rung == 0:
+            return None
+        self._rung -= 1
+        self._consecutive_breaches = 0
+        label = self.ladder[self._rung][0]
+        self.restorations.append({"rung": label, "reason": reason})
+        obs_spans.event("restore", cat="serve", rung=label, reason=reason)
+        self._metrics.counter(
+            "serve_restorations_total",
+            help="ladder rungs restored after overload recovery",
+        ).inc()
+        self._metrics.gauge(
+            "serve_ladder_rung",
+            help="current degradation-ladder rung index (0 = full)",
+        ).set(self._rung)
+        return label
 
     def _retire(self) -> BatchResult:
         res, t0, sid = self._inflight.popleft()
@@ -999,6 +1087,33 @@ class ServeSession:
                     error="poisoned-result",
                 )
                 raise
+        tenant_rows: dict[str, int] = {}
+        if res.tenants:
+            # aggregate the per-PART composition first: one tenant with
+            # several coalesced requests in this batch is still ONE batch
+            # (and one latency observation) for that tenant — iterating
+            # raw parts would inflate batches and latency_sum per request
+            for t, n in res.tenants:
+                tenant_rows[t] = tenant_rows.get(t, 0) + n
+            for t, n in tenant_rows.items():
+                st = self.tenant_stats.setdefault(t, {
+                    "queries": 0, "batches": 0,
+                    "latency_sum_s": 0.0, "latency_max_s": 0.0,
+                })
+                st["queries"] += n
+                st["batches"] += 1
+                st["latency_sum_s"] += res.latency_s
+                st["latency_max_s"] = max(st["latency_max_s"], res.latency_s)
+                self._metrics.counter(
+                    "serve_tenant_queries_total",
+                    help="query rows served per tenant (padding excluded)",
+                    labels={"tenant": t},
+                ).inc(n)
+                self._metrics.counter(
+                    "serve_tenant_batches_total",
+                    help="batches carrying at least one row of this tenant",
+                    labels={"tenant": t},
+                ).inc()
         extra = {}
         if res.stats_padded is not None:
             # the candidate-exchange story, stamped at retire (the batch
@@ -1018,6 +1133,16 @@ class ServeSession:
                 )
                 for s, n in enumerate(per_shard[:, 2].tolist()):
                     self.exchange["served_per_shard"][s] += int(n)
+            if tenant_rows and res.rows:
+                # tenant-attributable exchange: the routed volume is a
+                # batch-level fact (routes are per query TILE, tiles mix
+                # tenants), so the per-tenant share is rows-proportional
+                # — documented as an attribution, not a count
+                for t, n in tenant_rows.items():
+                    self.tenant_stats[t]["routed"] = (
+                        self.tenant_stats[t].get("routed", 0.0)
+                        + routed * n / res.rows
+                    )
             extra = {"routed": routed, "dropped": dropped}
             # the per-shard load event is the hang-attribution record: a
             # flight reader pairing an OPEN batch span with the LAST
@@ -1059,8 +1184,33 @@ class ServeSession:
         d, i, stats = _run(self.index, cfg, exec_, q2d, qids)
         return bucket, rows, poison_topk(d), i, stats, exec_.exchange_bytes
 
-    def submit(self, queries) -> list[BatchResult]:
+    def submit(self, queries, tenants=None) -> list[BatchResult]:
+        """Dispatch one batch; ``tenants`` is an optional
+        ``((tenant, rows), ...)`` composition in row order (a coalesced
+        multi-tenant batch from the serving front end) — it must sum to
+        the batch's row count, or the per-tenant accounting would
+        silently mis-attribute."""
         t0 = time.perf_counter()
+        if tenants is not None:
+            tenants = tuple((str(t), int(n)) for t, n in tenants)
+            for t, _ in tenants:
+                if not t or any(c in t for c in ('"', "\\", "\n", "\r")):
+                    # tenant ids become metrics LABELS at retire; a value
+                    # the exposition cannot carry must fail HERE at
+                    # submit (loud, at the caller) — not at retire inside
+                    # a dispatch pump that serves every other tenant
+                    raise ValueError(
+                        f"tenant id {t!r} must be non-empty with no "
+                        "quotes, backslashes, or newlines (it becomes a "
+                        "metrics label)"
+                    )
+            total = sum(n for _, n in tenants)
+            if total != int(queries.shape[0]):
+                raise ValueError(
+                    f"tenant composition sums to {total} rows but the "
+                    f"batch has {int(queries.shape[0])}: refusing to "
+                    "mis-attribute per-tenant stats"
+                )
         label, cfg = self.ladder[self._rung]
         # the batch span opens BEFORE the dispatch attempt: a hang inside
         # the dispatch leaves an OPEN "batch" record in the flight file —
@@ -1071,6 +1221,14 @@ class ServeSession:
         span_attrs = {}
         if self.index.backend == "ivf-sharded":
             span_attrs["shards"] = self.index.shards
+        if tenants is not None:
+            # the batch span carries the tenant composition: a hang's
+            # open-span diagnosis (or a slow batch in the flight record)
+            # names WHOSE rows were on board, not just how many
+            comp: dict[str, int] = {}
+            for t, n in tenants:
+                comp[t] = comp.get(t, 0) + n
+            span_attrs["tenants"] = comp
         sid = obs_spans.begin_span(
             "batch", cat="serve", seq=self._seq,
             rows=int(queries.shape[0]), rung=label, **span_attrs,
@@ -1114,6 +1272,7 @@ class ServeSession:
             degraded=None if label == FULL_RUNG else label,
             retries=retries,
             backoffs=backoffs,
+            tenants=tenants,
             stats_padded=stats,
             exchange_bytes=xbytes,
         )
@@ -1133,10 +1292,19 @@ class ServeSession:
             out.append(self._retire())
         return out
 
-    def stream(self, batches):
-        """Serve an iterable of batches, yielding results in order."""
+    def stream(self, batches, tenant: str | None = None):
+        """Serve an iterable of batches, yielding results in order.
+        ``tenant`` tags every batch as one tenant's stream (single-tenant
+        attribution — the ``mpi-knn query --tenant`` path); coalesced
+        multi-tenant batches use ``submit(..., tenants=...)`` directly."""
         for q in batches:
-            yield from self.submit(q)
+            yield from self.submit(
+                q,
+                tenants=(
+                    None if tenant is None
+                    else ((tenant, int(q.shape[0])),)
+                ),
+            )
         yield from self.drain()
 
     def profile(self, batches, trace_dir: str | None = None) -> dict:

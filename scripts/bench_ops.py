@@ -36,6 +36,15 @@ Two families, one JSON artifact:
   carrying the measured recall@k (and resident bytes for at-rest) so
   the 2×/4×/8× cuts are committed NEXT TO what they pay — the
   bytes-vs-recall ladder DESIGN.md tabulates is generated here.
+- ``frontend_qps`` / ``frontend_seq_baseline``: the serving FRONT END
+  (``mpi_knn_tpu.frontend``, ISSUE 11) — open-loop multi-tenant load
+  through the request coalescer at two tenant counts × an offered-QPS
+  sweep, each row carrying p50/p99 and achieved rows/s, next to the
+  per-stream depth-1 sequential-dispatch baseline over the SAME index
+  (each lone 16-row request padding to the full bucket — the pad waste
+  coalescing reclaims). The acceptance ratio (coalesced ≥ 2× sequential
+  at an equal p99 bound) is gated in tests/test_frontend_serve.py; these
+  rows pin its size per PR.
 - ``kmeans`` / ``ivf_query``: the clustered-index path (``mpi_knn_tpu.
   ivf``) on a SIFT-shaped corpus (uniform random data is clusterless and
   would only measure the method failing its preconditions) — one k-means
@@ -339,6 +348,76 @@ def main(argv=None) -> int:
         print(f"{'query_knn':16s} {row['variant']:16s} "
               f"median {row['median_s']}s  {row['queries_per_s']} q/s",
               flush=True)
+
+    # -- serving front end: coalesced multi-tenant vs sequential dispatch -
+    # (mpi_knn_tpu.frontend, ISSUE 11) over the SAME resident serial
+    # index as the query_knn rows — the comparison isolates coalescing.
+    # Open loop at two tenant counts × two offered per-tenant rates; the
+    # sequential baseline serves the identical request population one
+    # 16-row request at a time at dispatch depth 1.
+    from mpi_knn_tpu.frontend import Frontend, SLOPolicy
+    from mpi_knn_tpu.frontend import loadgen as fe_loadgen
+    from mpi_knn_tpu.resilience import ResiliencePolicy
+
+    fe_rows, fe_requests = 16, 12
+    lo_fe, hi_fe = float(np.min(X)), float(np.max(X))
+    seq_session = ServeSession(
+        index, config=index.cfg.replace(dispatch_depth=1)
+    )
+    seq_session.submit(np.zeros((128, d), np.float32))
+    seq_session.drain()
+    seq_session.reset_stats()
+    seq = fe_loadgen.run_sequential_baseline(
+        seq_session, tenants=8, n_requests=fe_requests, rows=fe_rows,
+        lo=lo_fe, hi=hi_fe,
+    )
+    row = {
+        "op": "frontend_seq_baseline",
+        "variant": f"t8-depth1-rows{fe_rows}",
+        "median_s": round(statistics.median(
+            sorted(seq_session.latencies)), 6) if seq_session.latencies
+        else None,
+        "min_s": round(min(seq_session.latencies), 6)
+        if seq_session.latencies else None,
+        "reps_s": [],
+        "p50_ms": seq["p50_ms"],
+        "p99_ms": seq["p99_ms"],
+        "queries_per_s": seq["achieved_qps_rows"],
+        "requests_per_s": seq["achieved_rps"],
+    }
+    results.append(row)
+    print(f"{'frontend':16s} {row['variant']:20s} "
+          f"{row['queries_per_s']} rows/s  p99 {row['p99_ms']}ms",
+          flush=True)
+    for fe_tenants in (2, 8):
+        for fe_qps in (100.0, 2000.0):
+            session = ServeSession(index, resilience=ResiliencePolicy())
+            fe = Frontend(session, SLOPolicy(
+                max_batch_rows=128, max_wait_s=0.002,
+                max_queue_rows=65536,
+            )).start()
+            rep = fe_loadgen.run_inprocess(
+                fe, tenants=fe_tenants, qps=fe_qps,
+                n_requests=fe_requests, rows=fe_rows, lo=lo_fe, hi=hi_fe,
+            )
+            fe.stop()
+            row = {
+                "op": "frontend_qps",
+                "variant": f"t{fe_tenants}-q{fe_qps:g}-rows{fe_rows}",
+                "median_s": None,
+                "min_s": None,
+                "reps_s": [],
+                "offered_qps_total": rep["offered_qps_total"],
+                "p50_ms": rep["p50_ms"],
+                "p99_ms": rep["p99_ms"],
+                "queries_per_s": rep["achieved_qps_rows"],
+                "requests_per_s": rep["achieved_rps"],
+                "rejected": rep["rejected"],
+            }
+            results.append(row)
+            print(f"{'frontend_qps':16s} {row['variant']:20s} "
+                  f"{row['queries_per_s']} rows/s  p50 {row['p50_ms']}ms "
+                  f"p99 {row['p99_ms']}ms", flush=True)
 
     # -- clustered (IVF) path: kmeans train + probed serving vs recall ----
     # On a SIFT-shaped corpus — NOT the uniform-pixel tile above: uniform

@@ -146,6 +146,61 @@ else
     fail=1
 fi
 
+note "serving front end gate (ISSUE 11: mpi-knn serve + loadgen)"
+# boot the REAL server on an ephemeral loopback port, drive a short
+# multi-tenant smoke through the production `mpi-knn loadgen` CLI, then
+# prove the operational artifacts are machine-readable: /metrics is
+# scraped over HTTP and re-parsed with the strict parse_prometheus (the
+# per-tenant labeled counters must survive the round trip), and the
+# flight record — coalesce events, batch spans with tenant composition —
+# passes the schema gate. The coalescing/fairness/shedding BEHAVIOR is
+# tier-1 (tests/test_frontend*.py); this gate proves the network path
+# end to end through the CLIs. The frontend lint cell (the coalesced
+# batch lowered through the production lower_bucket — no new programs)
+# runs inside the full `mpi-knn lint` sweep above; `--frontend` selects
+# it alone.
+FE_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP" "$FE_TMP"' EXIT
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu serve \
+    --data synthetic:2048x32c4 --k 10 --backend serial --bucket 128 \
+    --corpus-tile 512 --port 0 --ready-file "$FE_TMP/ready" \
+    --flight-record "$FE_TMP/flight.jsonl" \
+    --metrics-out "$FE_TMP/metrics.json" -q &
+FE_PID=$!
+fe_ok=0
+for _ in $(seq 1 120); do
+    [ -s "$FE_TMP/ready" ] && { fe_ok=1; break; }
+    kill -0 "$FE_PID" 2>/dev/null || break
+    sleep 1
+done
+if [ "$fe_ok" = 1 ]; then
+    FE_URL="$(cat "$FE_TMP/ready")"
+    timeout -k 10 120 python -m mpi_knn_tpu loadgen --url "$FE_URL" \
+        --tenants 2 --qps 40 --requests 10 --rows 16 \
+        --report "$FE_TMP/load.json" || fail=1
+    timeout -k 10 60 python - "$FE_URL" <<'PYEOF' || fail=1
+import sys, urllib.request
+from mpi_knn_tpu.obs.metrics import parse_prometheus
+with urllib.request.urlopen(sys.argv[1] + "/metrics", timeout=30) as r:
+    samples = parse_prometheus(r.read().decode())
+assert samples["serve_batches_total"] >= 1, "no batches served"
+assert any(k.startswith("serve_tenant_queries_total{") for k in samples), \
+    "per-tenant counters missing from the exposition"
+assert "frontend_queue_rows" in samples, "frontend gauge missing"
+print(f"frontend gate: {len(samples)} samples re-parsed, "
+      f"{samples['serve_batches_total']:.0f} batches")
+PYEOF
+    kill -TERM "$FE_PID" 2>/dev/null
+    wait "$FE_PID" || fail=1
+    python -m mpi_knn_tpu metrics --flight "$FE_TMP/flight.jsonl" \
+        --validate || fail=1
+    python -m mpi_knn_tpu metrics "$FE_TMP/metrics.json" --check || fail=1
+else
+    echo "frontend gate: server failed to come up"
+    kill "$FE_PID" 2>/dev/null
+    fail=1
+fi
+
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

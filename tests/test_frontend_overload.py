@@ -1,0 +1,131 @@
+"""Overload behavior of the serving front end (ISSUE 11 satellite): an
+injected slow-batch fault (the ``TKNN_FAULTS``/``install_faults``
+machinery from ``mpi_knn_tpu.resilience.faults``) drives coalescer queue
+growth → the SLO scheduler walks the serving degradation ladder down →
+offered load stops → the queue drains → the ladder walks back up. The
+rung walk is asserted from the METRICS REGISTRY and the FLIGHT RECORD —
+the durable artifacts an operator actually has — not from logs."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.frontend import Frontend, Rejection, SLOPolicy
+from mpi_knn_tpu.obs.metrics import get_registry
+from mpi_knn_tpu.obs.spans import (
+    FlightRecorder,
+    read_flight,
+    reconstruct_spans,
+    set_recorder,
+    validate_flight,
+)
+from mpi_knn_tpu.resilience import ResiliencePolicy
+from mpi_knn_tpu.resilience.faults import install_faults
+from mpi_knn_tpu.serve import ServeSession, build_index
+
+DIM = 16
+
+
+@pytest.fixture(scope="module")
+def index():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, DIM)).astype(np.float32)
+    return build_index(
+        X,
+        KNNConfig(k=4, backend="serial", query_bucket=32, corpus_tile=256,
+                  query_tile=32),
+    )
+
+
+def _counter(name) -> float:
+    return get_registry().counter(name).value
+
+
+def test_injected_slow_batches_shed_then_recover(index, tmp_path):
+    flight = tmp_path / "flight.jsonl"
+    set_recorder(FlightRecorder(str(flight), fresh=True))
+    deg0 = _counter("serve_degradations_total")
+    res0 = _counter("serve_restorations_total")
+    shed0 = _counter("frontend_overload_sheds_total")
+    rec0 = _counter("frontend_overload_recoveries_total")
+    try:
+        session = ServeSession(index, resilience=ResiliencePolicy())
+        assert len(session.ladder) >= 2  # something to shed into
+        fe = Frontend(session, SLOPolicy(
+            max_batch_rows=32,
+            max_wait_s=0.002,
+            max_queue_rows=100_000,
+            shed_queue_rows=128,
+            shed_hold_s=0.05,
+            recover_hold_s=0.05,
+        )).start()
+        try:
+            # every dispatch sleeps 60 ms: capacity ~16 batches/s * 32
+            # rows = ~500 rows/s; offer ~3200 rows/s for ~0.7 s so the
+            # queue deepens past the shed threshold and STAYS there
+            with install_faults({"serve-batch": ("slow", 0.06)}):
+                tickets = []
+                t_end = time.monotonic() + 0.7
+                while time.monotonic() < t_end:
+                    for ti in range(4):
+                        out = fe.submit(
+                            f"tenant-{ti}",
+                            np.zeros((16, DIM), np.float32),
+                        )
+                        if not isinstance(out, Rejection):
+                            tickets.append(out)
+                    time.sleep(0.02)
+                # offered load stops; the slow fault stays while the
+                # backlog drains, then serving returns to speed
+                deadline = time.monotonic() + 60
+                while (
+                    fe.session.rung != "full"
+                    or fe.scheduler.coalescer.pending_rows
+                ) and time.monotonic() < deadline:
+                    time.sleep(0.05)
+            for t in tickets:
+                t.result(timeout=60)  # nothing admitted was dropped
+        finally:
+            fe.stop()
+
+        # the walk happened: down under load, back up after drain —
+        # asserted from the process metrics registry
+        assert _counter("serve_degradations_total") > deg0
+        assert _counter("frontend_overload_sheds_total") > shed0
+        assert _counter("serve_restorations_total") > res0
+        assert _counter("frontend_overload_recoveries_total") > rec0
+        assert get_registry().gauge("serve_ladder_rung").value == 0.0
+        assert fe.session.rung == "full"
+        # the session event lists carry the reasons
+        assert any(
+            d["reason"] == "queue-overload" for d in session.degradations
+        )
+        assert any(
+            r["reason"] == "queue-recovered" for r in session.restorations
+        )
+    finally:
+        set_recorder(None)
+
+    # ... and from the flight record: a schema-clean trace containing
+    # the frontend shed event, the serve degrade event naming the rung
+    # and reason, and the restore back up
+    records = read_flight(str(flight))
+    assert validate_flight(records) == []
+    _, events = reconstruct_spans(records)
+    names = [e.get("name") for e in events]
+    assert "frontend-shed" in names and "frontend-recover" in names
+    degrades = [e for e in events if e.get("name") == "degrade"]
+    restores = [e for e in events if e.get("name") == "restore"]
+    assert degrades and restores
+    assert degrades[0]["attrs"]["reason"] == "queue-overload"
+    assert degrades[0]["attrs"]["rung"] in (
+        label for label, _ in session.ladder
+    )
+    assert restores[-1]["attrs"]["rung"] == "full"
+    assert restores[-1]["attrs"]["reason"] == "queue-recovered"
+    # the walk is ordered in the record: first shed precedes first restore
+    assert names.index("degrade") < names.index("restore")

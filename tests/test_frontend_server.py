@@ -1,0 +1,188 @@
+"""The network shell (ISSUE 11): the stdlib HTTP server and the HTTP
+load generator — POST /query (JSON and raw f32), the tenant header,
+structured 429s on the wire, GET /metrics re-parsed with the strict
+Prometheus parser, GET /healthz, and error routes. The behavioral logic
+under all of this is tested in test_frontend*.py; these tests pin the
+translation layer."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_knn_tpu.config import KNNConfig
+from mpi_knn_tpu.frontend import Frontend, FrontendHTTPServer, SLOPolicy
+from mpi_knn_tpu.frontend import loadgen
+from mpi_knn_tpu.obs.metrics import parse_prometheus
+from mpi_knn_tpu.resilience import ResiliencePolicy
+from mpi_knn_tpu.serve import ServeSession, build_index, query_knn
+
+DIM = 16
+
+
+@pytest.fixture(scope="module")
+def served():
+    """(server, frontend, index): one live loopback server for the
+    module (ephemeral port)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, DIM)).astype(np.float32)
+    index = build_index(
+        X,
+        KNNConfig(k=4, backend="serial", query_bucket=64, corpus_tile=256,
+                  query_tile=64),
+    )
+    fe = Frontend(
+        ServeSession(index, resilience=ResiliencePolicy()),
+        SLOPolicy(max_batch_rows=64, max_wait_s=0.002,
+                  max_queue_rows=8192),
+    ).start()
+    srv = FrontendHTTPServer(fe, port=0).start()
+    yield srv, fe, index
+    srv.stop()
+    fe.stop()
+
+
+def _post(url, path, data, headers):
+    req = urllib.request.Request(
+        url + path, data=data, headers=headers, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_json_query_roundtrip(served):
+    srv, fe, index = served
+    q = np.arange(2 * DIM, dtype=np.float32).reshape(2, DIM)
+    status, doc = _post(
+        srv.url, "/query",
+        json.dumps({"queries": q.tolist()}).encode(),
+        {"Content-Type": "application/json", "X-Tenant": "json-tenant"},
+    )
+    ref = query_knn(q, index)
+    assert status == 200 and doc["rows"] == 2
+    assert doc["ids"] == ref.ids.tolist()
+    assert np.allclose(np.asarray(doc["dists"], np.float32), ref.dists)
+    assert fe.session.tenant_stats["json-tenant"]["queries"] >= 2
+
+
+def test_raw_f32_query_bit_identical(served):
+    """The octet-stream body (little-endian f32 rows at the index dim)
+    returns the same ids as the JSON path for the same queries."""
+    srv, _, index = served
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(5, DIM)).astype("<f4")
+    status, doc = _post(
+        srv.url, "/query", q.tobytes(),
+        {"Content-Type": "application/octet-stream", "X-Tenant": "raw"},
+    )
+    ref = query_knn(np.asarray(q, np.float32), index)
+    assert status == 200 and doc["ids"] == ref.ids.tolist()
+
+
+def test_malformed_bodies_are_400(served):
+    srv, _, _ = served
+    for data, ctype in [
+        (b"not json", "application/json"),
+        (json.dumps({"queries": [[1.0, 2.0]]}).encode(),
+         "application/json"),  # wrong dim
+        (b"\x00" * 7, "application/octet-stream"),  # not whole f32 rows
+        (b"", "application/json"),  # empty body
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url, "/query", data, {"Content-Type": ctype})
+        assert ei.value.code == 400
+        assert "error" in json.loads(ei.value.read())
+
+
+def test_unknown_routes_are_404(served):
+    srv, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(srv.url + "/nope", timeout=10)
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(srv.url, "/elsewhere", b"{}",
+              {"Content-Type": "application/json"})
+    assert ei.value.code == 404
+
+
+def test_healthz_reports_serving_posture(served):
+    srv, _, index = served
+    doc = loadgen.probe_server(srv.url)
+    assert doc["ok"] is True
+    assert doc["dim"] == DIM and doc["k"] == index.cfg.k
+    assert doc["backend"] == "serial"
+    assert doc["rung"] == "full" and doc["ladder"][0] == "full"
+    assert doc["max_batch_rows"] == 64
+    assert doc["uptime_s"] >= 0
+
+
+def test_metrics_exposition_reparses_strictly(served):
+    """GET /metrics must round-trip through parse_prometheus — including
+    the labeled per-tenant counters — and carry the serving counters."""
+    srv, _, index = served
+    q = np.zeros((3, DIM), np.float32)
+    _post(srv.url, "/query",
+          json.dumps({"queries": q.tolist()}).encode(),
+          {"Content-Type": "application/json", "X-Tenant": "scraped"})
+    text = loadgen.fetch_metrics(srv.url)
+    samples = parse_prometheus(text)  # strict: malformed lines raise
+    assert samples["serve_batches_total"] >= 1
+    assert samples['serve_tenant_queries_total{tenant="scraped"}'] >= 3
+    assert "frontend_queue_rows" in samples
+    # one TYPE header per base family even with many tenant labels
+    type_lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith("# TYPE serve_tenant_queries_total ")
+    ]
+    assert len(type_lines) == 1
+
+
+def test_rate_limit_is_429_on_the_wire(served):
+    """A throttled tenant sees HTTP 429 with the structured body and a
+    Retry-After header (the scheduler's Rejection, translated)."""
+    srv, fe, _ = served
+    # drive through the frontend's real policy? the module fixture has no
+    # rate limit, so spin up a throttled server alongside
+    throttled = Frontend(
+        ServeSession(fe.session.index),
+        SLOPolicy(max_batch_rows=64, max_wait_s=0.002,
+                  max_queue_rows=8192, max_tenant_qps=0.25, burst=1),
+    ).start()
+    srv2 = FrontendHTTPServer(throttled, port=0).start()
+    try:
+        body = json.dumps(
+            {"queries": np.zeros((1, DIM)).tolist()}
+        ).encode()
+        hdr = {"Content-Type": "application/json", "X-Tenant": "hot"}
+        status, _ = _post(srv2.url, "/query", body, hdr)
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv2.url, "/query", body, hdr)
+        assert ei.value.code == 429
+        doc = json.loads(ei.value.read())
+        assert doc["error"] == "rate" and doc["tenant"] == "hot"
+        assert float(ei.value.headers["Retry-After"]) > 0
+        assert doc["retry_after_s"] > 0
+    finally:
+        srv2.stop()
+        throttled.stop()
+
+
+def test_http_loadgen_end_to_end(served):
+    """The open-loop HTTP load generator against the live server: all
+    requests served, per-tenant fairness, sane latency fields — the same
+    path `mpi-knn loadgen` drives in the CI gate."""
+    srv, _, _ = served
+    rep = loadgen.run_http(
+        srv.url, tenants=3, qps=60.0, n_requests=6, rows=8,
+    )
+    assert rep["errors"] == 0 and rep["rejected"] == 0
+    assert sum(rep["per_tenant"].values()) == 18
+    assert set(rep["per_tenant"].values()) == {6}
+    assert rep["p50_ms"] is not None and rep["p99_ms"] is not None
+    assert rep["achieved_qps_rows"] > 0
+    assert rep["offered_qps_total"] == pytest.approx(180.0)

@@ -979,3 +979,78 @@ def test_metrics_cli_validate_and_chrome_compose(tmp_path, capsys):
     ) == 0
     assert json.load(open(out))["traceEvents"]
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# labeled counters/gauges (ISSUE 11: the per-tenant axis)
+
+
+def test_labeled_counters_round_trip_prometheus():
+    """Labeled series render as canonical samples under ONE HELP/TYPE
+    header per base family, and the strict parser reads them back."""
+    from mpi_knn_tpu.obs.metrics import (
+        MetricsRegistry,
+        parse_prometheus,
+        to_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("served_total", help="rows", labels={"tenant": "a"}).inc(3)
+    reg.counter("served_total", help="rows", labels={"tenant": "b"}).inc(5)
+    reg.counter("served_total", help="rows",
+                labels={"tenant": "a"}).inc(2)  # same series, get-or-create
+    reg.gauge("depth", labels={"queue": "q0"}).set(7)
+    text = to_prometheus(reg.snapshot())
+    samples = parse_prometheus(text)
+    assert samples['served_total{tenant="a"}'] == 5.0
+    assert samples['served_total{tenant="b"}'] == 5.0
+    assert samples['depth{queue="q0"}'] == 7.0
+    type_lines = [ln for ln in text.splitlines() if ln.startswith("# TYPE")]
+    assert type_lines.count("# TYPE served_total counter") == 1
+
+
+def test_label_canonicalization_and_validation():
+    """Key order never forks a series; hostile values are refused (an
+    escaping-needed value would corrupt the exposition silently)."""
+    from mpi_knn_tpu.obs.metrics import MetricsRegistry, sample_name
+
+    assert sample_name("m", {"b": 1, "a": 2}) == 'm{a="2",b="1"}'
+    reg = MetricsRegistry()
+    c1 = reg.counter("m", labels={"a": "x", "b": "y"})
+    c2 = reg.counter("m", labels={"b": "y", "a": "x"})
+    assert c1 is c2
+    with pytest.raises(ValueError, match="escaping"):
+        reg.counter("m", labels={"a": 'inj"ect'})
+    with pytest.raises(ValueError, match="bad label name"):
+        reg.counter("m", labels={"0bad": "v"})
+    with pytest.raises(ValueError, match="bad metric name"):
+        reg.counter("bad name")
+
+
+def test_histograms_refuse_labels():
+    """A labeled histogram cannot be rendered correctly by name-keyed
+    storage (the _bucket suffix belongs before the labels) — refused
+    loudly rather than emitting malformed exposition."""
+    from mpi_knn_tpu.obs.metrics import MetricsRegistry
+
+    with pytest.raises(ValueError, match="labels are not supported"):
+        MetricsRegistry().histogram("lat", labels={"tenant": "a"})
+
+
+def test_mixed_kind_family_guard_spans_labels():
+    """A labeled counter and a bare gauge (or any other kind) sharing
+    one BASE family name must collide loudly — they would render a
+    mixed-kind family under one TYPE header (review regression)."""
+    from mpi_knn_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("fam_total", labels={"tenant": "a"})
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("fam_total")
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.histogram("fam_total")
+    # same kind, other labels (or bare) stays fine
+    reg.counter("fam_total", labels={"tenant": "b"})
+    reg.counter("fam_total")
+    reg.clear()
+    reg.gauge("fam_total")  # clear() resets the family map too

@@ -401,3 +401,100 @@ def test_get_executable_shapes(rng):
         ex = get_executable(idx, idx.cfg, bucket)
         assert ex.q_pad >= bucket
         assert ex.q_pad % ex.q_tile == 0
+
+
+# ---------------------------------------------------------------------------
+# session reuse across streams + per-tenant attribution (ISSUE 11
+# satellite: the front end's reporting leans on these exact semantics)
+
+
+def test_session_reusable_across_streams(rng, compile_counter):
+    """One session, two streams: the second stream compiles NOTHING
+    (the executable cache survives the window reset), reset_stats
+    resets ONLY the window accumulators, seq keeps counting so batch
+    provenance never aliases between streams, and results stay
+    bit-identical stream to stream."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    session = ServeSession(idx)
+    q = _data(rng, m=16)
+    out1 = list(session.stream([q, _data(rng, m=10)]))
+    assert session.queries_served == 26 and len(session.latencies) == 2
+    compile_counter.clear()
+
+    session.reset_stats()
+    assert session.queries_served == 0 and session.latencies == []
+    assert session.tenant_stats == {}
+
+    out2 = list(session.stream([q]))
+    assert compile_counter == []  # warm across the window boundary
+    # the new window counts only its own traffic
+    assert session.queries_served == 16 and len(session.latencies) == 1
+    # provenance is monotonic across streams, never re-zeroed
+    assert out2[0].seq == out1[-1].seq + 1
+    # bit-identity across windows (same query, same executable)
+    np.testing.assert_array_equal(out1[0].ids, out2[0].ids)
+    np.testing.assert_array_equal(out1[0].dists, out2[0].dists)
+
+
+def test_reset_mid_flight_lands_batch_in_new_window(rng):
+    """A batch in flight across reset_stats retires into the NEW window
+    — never dropped, never double-counted (the documented contract)."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial", dispatch_depth=4))
+    session = ServeSession(idx)
+    session.submit(_data(rng, m=16), tenants=(("t", 16),))
+    assert session._inflight  # depth 4: not yet retired
+    session.reset_stats()
+    done = session.drain()
+    assert len(done) == 1
+    assert session.queries_served == 16 and len(session.latencies) == 1
+    assert session.tenant_stats["t"]["queries"] == 16
+
+
+def test_tenant_attribution_is_first_class(rng):
+    """Per-tenant accumulators are session state, not deltas: a
+    coalesced composition feeds each tenant's rows/batches/latency, the
+    stream(tenant=...) form tags a whole stream, and the labeled
+    registry counters carry the same numbers."""
+    from mpi_knn_tpu.obs.metrics import get_registry
+
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    session = ServeSession(idx)
+    c0 = get_registry().counter(
+        "serve_tenant_queries_total", labels={"tenant": "a"}
+    ).value
+    session.submit(
+        _data(rng, m=16), tenants=(("a", 10), ("b", 6))
+    )
+    session.drain()
+    list(session.stream([_data(rng, m=8)], tenant="a"))
+    st = session.tenant_stats
+    assert st["a"]["queries"] == 18 and st["b"]["queries"] == 6
+    assert st["a"]["batches"] == 2 and st["b"]["batches"] == 1
+    assert st["a"]["latency_sum_s"] >= st["a"]["latency_max_s"] > 0
+    assert get_registry().counter(
+        "serve_tenant_queries_total", labels={"tenant": "a"}
+    ).value == c0 + 18
+    # untagged legacy batches attribute nothing (zero-overhead default)
+    session.submit(_data(rng, m=16))
+    session.drain()
+    assert sum(s["queries"] for s in st.values()) == 24
+
+
+def test_tenant_composition_aggregates_parts(rng):
+    """Several coalesced requests of ONE tenant in one batch are one
+    batch (and one latency observation) for that tenant, and hostile
+    tenant ids fail loudly at submit, not at retire inside a pump
+    (review regressions)."""
+    X = _data(rng)
+    idx = build_index(X, _cfg("serial"))
+    session = ServeSession(idx)
+    session.submit(_data(rng, m=16), tenants=(("a", 8), ("a", 4), ("a", 4)))
+    session.drain()
+    st = session.tenant_stats["a"]
+    assert st["queries"] == 16 and st["batches"] == 1
+    assert st["latency_sum_s"] == st["latency_max_s"]  # ONE observation
+    with pytest.raises(ValueError, match="metrics label"):
+        session.submit(_data(rng, m=8), tenants=(('bad"id', 8),))
