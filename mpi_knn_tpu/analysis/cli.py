@@ -70,6 +70,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=8,
                    help="virtual CPU device count for the ring mesh "
                    "(default 8)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="jax persistent compilation cache for the "
+                   "matrix's compile step: lint cells that share a "
+                   "program (and repeated lint runs — the check.sh "
+                   "gates run overlapping sweeps) reuse compiled "
+                   "artifacts instead of re-invoking XLA. This is "
+                   "jax's own cache, NOT the serve AOT cache: lint "
+                   "needs before/after-opt HLO text, which only a "
+                   "real compile step (cached at the XLA layer) "
+                   "provides")
     p.add_argument("-q", "--quiet", action="store_true")
     return p
 
@@ -95,6 +105,14 @@ def main(argv=None) -> int:
     # the float64 column is the debug-precision mode; without x64 those
     # lowerings would silently be float32 programs wearing an f64 label
     jax.config.update("jax_enable_x64", True)
+
+    if args.cache_dir:
+        # compile-level reuse across cells and runs: thresholds zeroed so
+        # even the tiny lint programs cache (the defaults skip sub-second
+        # compiles, which is every CPU lint cell)
+        jax.config.update("jax_compilation_cache_dir", args.cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
     from mpi_knn_tpu.analysis.engine import run_matrix
     from mpi_knn_tpu.analysis.lowering import default_targets

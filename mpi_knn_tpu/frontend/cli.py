@@ -69,6 +69,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="base row bucket of the executable cache; batches "
                    "pad to bucket*2^j rows")
     d.add_argument("--dispatch-depth", type=int, default=2)
+    d.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persistent AOT executable cache "
+                   "(serve/aotcache.py; also via TKNN_AOT_CACHE): a "
+                   "restarted server revives every executable it has "
+                   "ever compiled from disk instead of re-paying XLA — "
+                   "the second start against one dir warms with zero "
+                   "backend compiles. Stale/corrupt entries fall back "
+                   "to a real compile loudly; the dir is safe to share "
+                   "between concurrent processes (atomic-rename writes)")
+    d.add_argument("--warm-threads", type=int, default=None,
+                   help="thread-pool width of the start-up warm "
+                   "(default: auto = min(cells, cpu count); 1 forces "
+                   "the sequential walk)")
 
     f = p.add_argument_group("front end (coalescing / SLO)")
     f.add_argument("--max-wait-ms", type=float, default=2.0,
@@ -134,10 +147,19 @@ def serve_main(argv=None) -> int:
               "knobs would be silently inert", file=sys.stderr)
         return 2
 
+    if args.warm_threads is not None and args.warm_threads < 1:
+        print("error: --warm-threads must be >= 1", file=sys.stderr)
+        return 2
+
     if args.flight_record:
         from mpi_knn_tpu.obs.spans import FlightRecorder, set_recorder
 
         set_recorder(FlightRecorder(args.flight_record, fresh=True))
+
+    if args.cache_dir:
+        from mpi_knn_tpu.serve import aotcache
+
+        aotcache.set_cache_dir(args.cache_dir)
 
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
@@ -191,8 +213,16 @@ def serve_main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    # cold-start order (ISSUE 12): bind the port and write the ready
+    # file BEFORE warming — the warm-up runs on a background thread and
+    # /healthz reports its buckets-ready/total progress, so time-to-
+    # listening is index load, not the compile matrix; traffic is
+    # admitted per bucket as executables land (a not-yet-ready bucket
+    # gets a structured 503 "warming", never a hung socket)
     frontend = Frontend(session, policy)
-    frontend.start(warm_sizes=[policy.max_batch_rows])
+    frontend.start(
+        background=True, warm_parallel=args.warm_threads,
+    )
     server = FrontendHTTPServer(
         frontend, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout_s, quiet=args.quiet,
@@ -202,12 +232,29 @@ def serve_main(argv=None) -> int:
         print(
             f"[mpi-knn serve] {source} shape={list(X.shape)} "
             f"backend={index.backend} k={cfg.k} bucket={cfg.query_bucket} "
-            f"max_wait={args.max_wait_ms}ms (index+warm {build_s:.2f}s)"
+            f"max_wait={args.max_wait_ms}ms (index+bind {build_s:.2f}s, "
+            "warming in background)"
         )
         print(f"[mpi-knn serve] listening on {server.url}", flush=True)
     if args.ready_file:
         with open(args.ready_file, "w") as f:
             f.write(server.url + "\n")
+
+    def _report_warm():
+        frontend._serving_ready.wait()
+        rep = session.warm_report or {}
+        if not args.quiet and rep:
+            print(
+                f"[mpi-knn serve] warm done in {rep.get('wall_s')}s: "
+                f"{rep.get('cells')} cells ({rep.get('compiled')} "
+                f"compiled, {rep.get('loaded')} from cache, "
+                f"{rep.get('deduped')} deduped)"
+                + (f" cache={args.cache_dir}" if args.cache_dir else ""),
+                flush=True,
+            )
+
+    threading.Thread(target=_report_warm, daemon=True,
+                     name="warm-report").start()
 
     stop = threading.Event()
 

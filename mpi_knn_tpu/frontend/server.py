@@ -119,40 +119,90 @@ class Frontend:
         self._stop = False
         self._crashed: BaseException | None = None
         self.started_s = time.monotonic()
+        # cold-start readiness (ISSUE 12): set once start-up warming —
+        # executable builds at every rung plus the one-time dispatch-path
+        # plumbing — has finished. While unset, admission is PER BUCKET:
+        # a request whose row bucket's executable has landed serves, the
+        # rest get a structured 503 "warming" with the progress counters.
+        self._serving_ready = threading.Event()
+        self._warm_thread: threading.Thread | None = None
         self._pump = threading.Thread(
             target=self._run, name="frontend-pump", daemon=True
         )
 
     # -- lifecycle --------------------------------------------------------
 
-    def start(self, warm_sizes=None) -> "Frontend":
-        """Start the pump; ``warm_sizes`` (row counts) pre-compiles those
-        buckets at EVERY ladder rung first, so neither the first batch
-        nor a shed rung ever cold-compiles into live traffic (default:
-        the policy's full batch target). One real zero-batch dispatch
-        then runs per size via the one-shot ``query_knn`` path: the
-        first dispatch pays jax's one-time dispatch-path setup
-        (~hundreds of ms) on top of the AOT cache, and that cost belongs
-        in startup, not in the first client's latency. ``query_knn``
-        shares the executables and dispatch machinery but feeds NO
-        session window stats and NO serving counters/histograms — the
-        warm-up is plumbing and must be invisible to /metrics, not
-        merely wiped from the session window."""
-        sizes = (
-            [self.policy.max_batch_rows] if warm_sizes is None
-            else list(warm_sizes)
-        )
-        if sizes:
-            from mpi_knn_tpu.serve.engine import query_knn
+    def start(self, warm_sizes=None, background: bool = False,
+              warm_parallel: int | None = None) -> "Frontend":
+        """Start the pump; ``warm_sizes`` (row counts) pre-builds those
+        buckets at EVERY ladder rung first — via the persistent AOT
+        cache when one is active, across ``warm_parallel`` threads
+        (None = auto) — so neither the first batch nor a shed rung ever
+        cold-compiles into live traffic (default: the policy's full
+        batch target). One real zero-batch dispatch then runs per size
+        via the one-shot ``query_knn`` path: the first dispatch pays
+        jax's one-time dispatch-path setup (~hundreds of ms) on top of
+        the AOT cache, and that cost belongs in startup, not in the
+        first client's latency. ``query_knn`` shares the executables and
+        dispatch machinery but feeds NO session window stats and NO
+        serving counters/histograms — the warm-up is plumbing and must
+        be invisible to /metrics, not merely wiped from the session
+        window.
 
-            self.session.warm(sizes)
-            dim = self.session.index.dim
-            for n in sizes:
-                query_knn(
-                    np.zeros((n, dim), np.float32), self.session.index,
-                    self.session.cfg,
-                )
-        self._pump.start()
+        ``background=True`` is the bind-the-port-first cold-start shape
+        (ISSUE 12): the pump starts IMMEDIATELY and the warm-up runs on
+        a daemon thread, so the HTTP server can listen while executables
+        are still landing. Until the warm-up finishes, ``submit`` admits
+        per bucket (``session.coalesced_ready``): traffic whose whole
+        coalescable bucket span has landed serves at once, the rest get
+        a structured 503 "warming" rejection carrying the buckets-
+        ready/total progress that ``/healthz`` also reports.
+
+        The default warm set is the full bucket LADDER from the config's
+        base bucket up to the fill target — not just the fill target:
+        a coalesced batch can land in any power-of-two bucket in that
+        span (a ragged deadline dispatch, a lull), and per-bucket
+        admission during warming is only safe when the span a request
+        could reach is entirely built."""
+        if warm_sizes is None:
+            base = self.session.cfg.query_bucket
+            top = self.policy.max_batch_rows
+            sizes, b = [], base
+            while b < top:
+                sizes.append(b)
+                b *= 2
+            sizes.append(top)
+        else:
+            sizes = list(warm_sizes)
+
+        def _warm():
+            try:
+                if sizes:
+                    from mpi_knn_tpu.serve.engine import query_knn
+
+                    self.session.warm(sizes, parallel=warm_parallel)
+                    dim = self.session.index.dim
+                    for n in sizes:
+                        query_knn(
+                            np.zeros((n, dim), np.float32),
+                            self.session.index, self.session.cfg,
+                        )
+            finally:
+                # a failed warm releases the gate anyway: the same
+                # failure will re-raise loudly on the dispatch path
+                # (where the pump's error machinery fails tickets),
+                # whereas a stuck gate would 503 every client forever
+                self._serving_ready.set()
+
+        if background:
+            self._pump.start()
+            self._warm_thread = threading.Thread(
+                target=_warm, name="frontend-warm", daemon=True
+            )
+            self._warm_thread.start()
+        else:
+            _warm()
+            self._pump.start()
         return self
 
     def stop(self, timeout: float = 30.0) -> None:
@@ -172,6 +222,25 @@ class Frontend:
         if queries.ndim != 2:
             raise ValueError(
                 f"queries must be (rows, dim), got shape {queries.shape}"
+            )
+        if not self._serving_ready.is_set() and not \
+                self.session.coalesced_ready(
+                    queries.shape[0], self.policy.max_batch_rows
+                ):
+            # per-bucket admission while warming (ISSUE 12): traffic
+            # whose executable has landed serves immediately; the rest
+            # are refused with the warming progress, not queued behind a
+            # compile that would blow their deadline anyway
+            ws = dict(self.session.warm_state)
+            return Rejection(
+                tenant=str(tenant), reason="warming",
+                detail=(
+                    f"bucket for {queries.shape[0]} rows not compiled "
+                    f"yet ({ws['ready']}/{ws['total']} executables "
+                    "ready)"
+                ),
+                retry_after_s=0.5,
+                status=503,
             )
         with self._lock:
             if self._stop or self._crashed is not None:
@@ -194,8 +263,18 @@ class Frontend:
         """The health/posture snapshot ``GET /healthz`` serves."""
         ses = self.session
         with self._lock:
+            warm = dict(ses.warm_state)
             return {
                 "ok": self._crashed is None,
+                # cold-start posture (ISSUE 12): executables ready/total
+                # while warming, and whether start-up warming is done —
+                # the CI gate's time-to-ready rendezvous reads this
+                "ready": self._serving_ready.is_set(),
+                "warming": {
+                    "ready": warm["ready"],
+                    "total": warm["total"],
+                    "done": self._serving_ready.is_set(),
+                },
                 "uptime_s": round(time.monotonic() - self.started_s, 3),
                 "queue_rows": self.scheduler.coalescer.pending_rows,
                 "queue_requests": self.scheduler.coalescer.pending_requests,

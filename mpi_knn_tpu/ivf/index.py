@@ -45,6 +45,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import os
 from typing import Optional
 
 import jax
@@ -458,55 +459,95 @@ def save_ivf_index(index, path: str) -> str:
         "store_dtype": index.cfg.dtype,
         "has_mu": index.mu is not None,
     }
-    np.savez(
-        path,
-        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        centroids=np.asarray(index.centroids),
-        centroid_sqs=np.asarray(index.centroid_sqs),
-        buckets=buckets,
-        bucket_ids=np.asarray(index.bucket_ids),
-        bucket_sqs=np.asarray(index.bucket_sqs),
-        bucket_scales=(np.asarray(index.bucket_scales)
-                       if index.bucket_scales is not None
-                       else np.zeros(0, np.float32)),
-        mu=(np.asarray(index.mu)
-            if index.mu is not None else np.zeros(0)),
-    )
+    # write-to-temp + atomic rename: a re-save over a path another
+    # process is serving from (or has mmapped mid-load) must never
+    # expose a torn archive — the reader keeps the old inode, the new
+    # file replaces it whole (the aotcache entry-write convention)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+            centroids=np.asarray(index.centroids),
+            centroid_sqs=np.asarray(index.centroid_sqs),
+            buckets=buckets,
+            bucket_ids=np.asarray(index.bucket_ids),
+            bucket_sqs=np.asarray(index.bucket_sqs),
+            bucket_scales=(np.asarray(index.bucket_scales)
+                           if index.bucket_scales is not None
+                           else np.zeros(0, np.float32)),
+            mu=(np.asarray(index.mu)
+                if index.mu is not None else np.zeros(0)),
+        )
+    os.replace(tmp, path)
     return path
 
 
-def load_ivf_index(path: str) -> IVFIndex:
+def load_ivf_index(path: str, mmap: bool = True) -> IVFIndex:
     """Reload a :func:`save_ivf_index` ``.npz`` — arrays land back on
-    device bit-identically; the executable cache starts empty."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        cfg = KNNConfig(**meta["cfg"])
-        buckets = z["buckets"]
-        if meta["buckets_bf16"]:
-            import ml_dtypes  # jax dependency; numpy has no native bf16
+    device bit-identically; the executable cache starts empty.
 
-            buckets = jnp.asarray(buckets.view(ml_dtypes.bfloat16))
-        else:
-            buckets = jnp.asarray(buckets)
-        store = meta.get("store_dtype", cfg.dtype)
-        scales = None
-        if store in QUANT_DTYPES:
-            scales = jnp.asarray(z["bucket_scales"]).reshape(
-                meta["partitions"], meta["bucket_cap"]
+    ``mmap=True`` (the default) maps the archive's uncompressed members
+    read-only instead of decompress-copying them into host memory
+    (``utils/npz_mmap``): nothing reads until ``jax.device_put`` touches
+    the pages, so disk read and H2D transfer fuse into one pass and the
+    host never holds a second corpus copy — the cold-start zero-copy
+    path (DESIGN.md "Cold start"), pipelining index load under the AOT
+    warm pool's compiles. An archive the mapper cannot handle (a
+    compressed ``savez_compressed`` file, foreign members) falls back to
+    the copying ``np.load`` reader LOUDLY (``RuntimeWarning``), with
+    bit-identical results either way."""
+    z: dict | None = None
+    if mmap:
+        from mpi_knn_tpu.utils.npz_mmap import mmap_npz
+
+        try:
+            z = mmap_npz(path)
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(
+                f"cannot mmap index {path!r} ({e}); falling back to the "
+                "copying np.load reader",
+                RuntimeWarning,
+                stacklevel=2,
             )
-        return IVFIndex(
-            cfg=cfg,
-            m=meta["m"],
-            dim=meta["dim"],
-            partitions=meta["partitions"],
-            bucket_cap=meta["bucket_cap"],
-            nprobe=meta["nprobe"],
-            tuned_recall=meta["tuned_recall"],
-            mu=z["mu"] if meta["has_mu"] else None,
-            centroids=jnp.asarray(z["centroids"]),
-            centroid_sqs=jnp.asarray(z["centroid_sqs"]),
-            buckets=buckets,
-            bucket_ids=jnp.asarray(z["bucket_ids"]),
-            bucket_sqs=jnp.asarray(z["bucket_sqs"]),
-            bucket_scales=scales,
+    if z is None:
+        with np.load(path) as zf:
+            z = {k: zf[k] for k in zf.files}
+    meta = json.loads(bytes(np.asarray(z["meta"])).decode())
+    cfg = KNNConfig(**meta["cfg"])
+    buckets = z["buckets"]
+    if meta["buckets_bf16"]:
+        import ml_dtypes  # jax dependency; numpy has no native bf16
+
+        buckets = jnp.asarray(buckets.view(ml_dtypes.bfloat16))
+    else:
+        buckets = jnp.asarray(buckets)
+    store = meta.get("store_dtype", cfg.dtype)
+    scales = None
+    if store in QUANT_DTYPES:
+        scales = jnp.asarray(z["bucket_scales"]).reshape(
+            meta["partitions"], meta["bucket_cap"]
         )
+    return IVFIndex(
+        cfg=cfg,
+        m=meta["m"],
+        dim=meta["dim"],
+        partitions=meta["partitions"],
+        bucket_cap=meta["bucket_cap"],
+        nprobe=meta["nprobe"],
+        tuned_recall=meta["tuned_recall"],
+        # np.array (a COPY), never np.asarray: on the mmap path asarray
+        # would return a view pinning the file mapping for the index's
+        # whole lifetime — every other field is copied to device by
+        # jnp.asarray, and the zero-copy contract is "the mapping is
+        # dropped once load returns"
+        mu=np.array(z["mu"]) if meta["has_mu"] else None,
+        centroids=jnp.asarray(z["centroids"]),
+        centroid_sqs=jnp.asarray(z["centroid_sqs"]),
+        buckets=buckets,
+        bucket_ids=jnp.asarray(z["bucket_ids"]),
+        bucket_sqs=jnp.asarray(z["bucket_sqs"]),
+        bucket_scales=scales,
+    )

@@ -36,7 +36,7 @@ DEFAULT_BEAT_TIMEOUT_S = 60.0
 DEFAULT_WALL_TIMEOUT_S = 180.0
 
 
-def _probe_child(platform: str) -> int:
+def _probe_child(platform: str, cache_dir: str | None = None) -> int:
     """The probe body, run inside the supervised worker subprocess: tiny
     jit + device_sync under heartbeats. Beats bracket every step that can
     hang so the supervisor's kill names the wedged step."""
@@ -86,6 +86,24 @@ def _probe_child(platform: str) -> int:
     # supervisor folds it into the verdict as hard evidence the device
     # compiled and ran SOMETHING, not just that the process exited 0
     print(json.dumps({"metrics": get_registry().snapshot()}), flush=True)
+    # third stdout line (ISSUE 12): the persistent AOT cache probe —
+    # explicit --cache-dir wins, TKNN_AOT_CACHE is honored ambiently.
+    # The round trip stores then revives a tiny executable through the
+    # PRODUCTION cache path and compares outputs bit-for-bit, so the
+    # verdict says "this dir on this platform can actually persist an
+    # executable", not just "the dir exists"
+    from mpi_knn_tpu.serve import aotcache
+
+    cache = (aotcache.set_cache_dir(cache_dir) if cache_dir
+             else aotcache.active_cache())
+    if cache is not None:
+        maybe_beat("aot-cache-probe")
+        rt = aotcache.probe_roundtrip(cache)
+        # stats AFTER the round trip so the entry count includes the
+        # probe's own entry (0 entries + store_ok would read as broken)
+        doc = {**cache.stats(), **rt}
+        print(json.dumps({"aot_cache": doc}), flush=True)
+        maybe_beat("aot-cache-done")
     return 0
 
 
@@ -94,21 +112,29 @@ def run_probe(
     beat_timeout_s: float = DEFAULT_BEAT_TIMEOUT_S,
     wall_timeout_s: float = DEFAULT_WALL_TIMEOUT_S,
     env: dict | None = None,
+    cache_dir: str | None = None,
 ) -> dict:
     """Run the supervised probe and build the verdict document — shared
     by the CLI below and the bench supervisor's ``BENCH_DOCTOR=1``
-    preflight (which must not print to its own stdout)."""
+    preflight (which must not print to its own stdout). ``cache_dir``
+    (or an ambient ``TKNN_AOT_CACHE``) adds the persistent AOT cache
+    block: dir, entry count, bytes, and a store/load round trip of a
+    tiny probe executable."""
+    argv = [
+        "-m", "mpi_knn_tpu", "doctor", "--child",
+        "--platform", platform,
+    ]
+    if cache_dir:
+        argv += ["--cache-dir", cache_dir]
     res = run_supervised(
-        python_worker_argv(
-            "-m", "mpi_knn_tpu", "doctor", "--child",
-            "--platform", platform,
-        ),
+        python_worker_argv(*argv),
         env=env,
         beat_timeout_s=beat_timeout_s,
         wall_timeout_s=wall_timeout_s,
     )
     probe = None
     metrics = None
+    aot_cache = None
     if res.ok:
         for line in res.stdout.splitlines():
             try:
@@ -119,7 +145,12 @@ def run_probe(
                 probe = doc
             elif isinstance(doc, dict) and "metrics" in doc:
                 metrics = doc["metrics"]
+            elif isinstance(doc, dict) and "aot_cache" in doc:
+                aot_cache = doc["aot_cache"]
     return {
+        # the AOT cache block (ISSUE 12): None when no cache dir is
+        # configured — absent, not a fake-healthy zero row
+        "aot_cache": aot_cache,
         "ok": bool(res.ok and probe is not None),
         "status": res.status if probe is not None or not res.ok
         else "crashed",  # rc 0 but no probe line = a broken child
@@ -155,6 +186,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="outer wall-clock bound in seconds")
     p.add_argument("--report", default=None,
                    help="also write the JSON verdict to this path")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="probe this persistent AOT executable cache "
+                   "(serve/aotcache.py; TKNN_AOT_CACHE is honored "
+                   "without the flag): the verdict gains an aot_cache "
+                   "block with dir, entry count, bytes, and a store/"
+                   "load round trip of a tiny probe executable")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     return p
 
@@ -162,12 +199,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.child:
-        return _probe_child(args.platform)
+        return _probe_child(args.platform, cache_dir=args.cache_dir)
     verdict = run_probe(
         platform=args.platform,
         beat_timeout_s=args.timeout,
         wall_timeout_s=args.wall_timeout,
         env=dict(os.environ),
+        cache_dir=args.cache_dir,
     )
     print(json.dumps(verdict), flush=True)
     if args.report:

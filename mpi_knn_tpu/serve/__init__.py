@@ -14,8 +14,13 @@ Public surface::
 
 Design rationale and the machine-checked donation/copy contract (lint
 rule R5): ``serve/engine.py`` docstring and DESIGN.md "Serving pipeline".
+Cold start — the persistent on-disk executable cache
+(``serve/aotcache.py``: ``aotcache.set_cache_dir`` / ``TKNN_AOT_CACHE``,
+CLI ``--cache-dir``), fingerprint-deduped parallel ``warm()``, and the
+zero-copy index load — is DESIGN.md "Cold start".
 """
 
+from mpi_knn_tpu.serve import aotcache
 from mpi_knn_tpu.serve.engine import (
     BatchResult,
     ServeSession,
@@ -29,6 +34,7 @@ __all__ = [
     "BatchResult",
     "CorpusIndex",
     "ServeSession",
+    "aotcache",
     "bucket_rows",
     "build_index",
     "get_executable",

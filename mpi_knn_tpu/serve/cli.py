@@ -134,6 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max batches in flight (2 = double buffering)")
     k.add_argument("--no-donate", action="store_true",
                    help="disable per-batch scratch donation (debugging)")
+    k.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persistent AOT executable cache "
+                   "(serve/aotcache.py; also via TKNN_AOT_CACHE): "
+                   "executables this process compiles are serialized "
+                   "here and revived on the next run — a repeated query "
+                   "run against one dir warms with zero XLA backend "
+                   "compiles (the summary/report carry the hit/miss "
+                   "story). Stale or corrupt entries recompile loudly")
 
     r = p.add_argument_group(
         "resilience (mpi_knn_tpu.resilience: deadline, retry, sentinel, "
@@ -314,6 +322,13 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.obs.spans import FlightRecorder, set_recorder
 
         set_recorder(FlightRecorder(args.flight_record, fresh=True))
+
+    if args.cache_dir:
+        # before any executable builds, so even the first bucket of the
+        # stream can revive from (or land in) the persistent cache
+        from mpi_knn_tpu.serve import aotcache
+
+        aotcache.set_cache_dir(args.cache_dir)
 
     if args.platform != "auto":
         from mpi_knn_tpu.utils.platform import force_platform
@@ -579,6 +594,25 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
                 cfg, index.corpus_sharded.shape[0], index.dim,
                 index.ring_meta[3],
             ),
+        }
+    from mpi_knn_tpu.serve import aotcache as _aotcache
+
+    _disk = _aotcache.active_cache()
+    if _disk is not None:
+        # the cold-start story next to the throughput it bought: cache
+        # size plus this process's hit/miss/error counters (the same
+        # numbers the registry exports as aot_cache_*_total)
+        from mpi_knn_tpu.obs.metrics import get_registry
+
+        _reg = get_registry()
+        summary["aot_cache"] = {
+            **_disk.stats(),
+            "hits": int(_reg.counter("aot_cache_hits_total").snapshot()
+                        ["value"]),
+            "misses": int(_reg.counter("aot_cache_misses_total").snapshot()
+                          ["value"]),
+            "errors": int(_reg.counter("aot_cache_errors_total").snapshot()
+                          ["value"]),
         }
     if session.tenant_stats:
         # the per-tenant window accumulators (first-class session state,

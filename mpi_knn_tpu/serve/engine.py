@@ -46,8 +46,11 @@ per-batch records. The fault-injection hooks
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import dataclasses
 import functools
+import os
+import threading
 import time
 
 import jax
@@ -214,15 +217,132 @@ class _BucketExec:
     # exchange-bytes counter without reading the device
     route_cap: int | None = None
     exchange_bytes: int | None = None
+    # how this cell's executable came to exist: "compiled" (a real XLA
+    # compile in this process) or "cache-hit" (revived from the
+    # persistent AOT cache, zero XLA work) — warm() reports tally it
+    source: str = "compiled"
 
 
 def _acc_dtype(cfg: KNNConfig):
     return jnp.float64 if cfg.dtype == "float64" else jnp.float32
 
 
-def _serial_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
+def _resident_args(index) -> tuple:
+    """The index-side arguments of one batch program, in call order — the
+    ONE place that order lives: the lowered builders, the dispatch path
+    (``_run``) and the persistent-cache signature check all consume this,
+    so the three can never drift. ``None`` entries (e.g. the scales array
+    of an unquantized ring index) are empty pytree nodes that jax drops
+    from the flattened argument list."""
+    b = index.backend
+    if b == "serial":
+        return (index.tiles, index.tile_ids, index.tile_sqs)
+    if b in ("ring", "ring-overlap"):
+        return (index.corpus_sharded, index.corpus_ids_sharded,
+                index.corpus_scales_sharded)
+    if b == "pallas":
+        return (index.corpus_padded,)
+    # ivf / ivf-sharded share the clustered store layout
+    return (index.centroids, index.centroid_sqs, index.buckets,
+            index.bucket_ids, index.bucket_sqs, index.bucket_scales)
+
+
+def _serial_bucket_shapes(index, cfg: KNNConfig, bucket: int):
     q_tile = min(cfg.query_tile, pad_to_multiple(bucket, 8))
-    q_pad = pad_to_multiple(bucket, q_tile)
+    return pad_to_multiple(bucket, q_tile), q_tile
+
+
+def _pallas_bucket_shapes(index, cfg: KNNConfig, bucket: int):
+    q_tile = min(max(8, pad_to_multiple(cfg.query_tile, 8)), 512,
+                 pad_to_multiple(bucket, 8))
+    return pad_to_multiple(bucket, q_tile), q_tile
+
+
+def _ring_bucket_shapes(index, cfg: KNNConfig, bucket: int):
+    q_tile, q_pad = ring_query_shapes(index, cfg, bucket)
+    return q_pad, q_tile
+
+
+def _ivf_bucket_shapes(index, cfg: KNNConfig, bucket: int):
+    from mpi_knn_tpu.ivf.search import ivf_query_shapes
+
+    q_tile, q_pad = ivf_query_shapes(
+        cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket
+    )
+    return q_pad, q_tile
+
+
+def _ivf_sharded_bucket_shapes(index, cfg: KNNConfig, bucket: int):
+    from mpi_knn_tpu.ivf.sharded import sharded_query_shapes
+
+    q_tile, q_pad, _ = sharded_query_shapes(
+        cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket, index.shards
+    )
+    return q_pad, q_tile
+
+
+_BUCKET_SHAPES = {
+    "serial": _serial_bucket_shapes,
+    "ring": _ring_bucket_shapes,
+    "ring-overlap": _ring_bucket_shapes,
+    "pallas": _pallas_bucket_shapes,
+    "ivf": _ivf_bucket_shapes,
+    "ivf-sharded": _ivf_sharded_bucket_shapes,
+}
+
+
+def bucket_shapes(index, cfg: KNNConfig, bucket: int):
+    """``(q_pad, q_tile)`` of one (bucket, config) cell — pure shape
+    math, shared by the lowered builders below and the persistent-cache
+    hit path (which must build a dispatchable :class:`_BucketExec`
+    WITHOUT tracing or lowering anything)."""
+    return _BUCKET_SHAPES[index.backend](index, cfg, bucket)
+
+
+def expected_args(index, cfg: KNNConfig, bucket: int) -> list:
+    """The flattened ``(shape, dtype)`` input signature the cell's
+    executable must carry, derived from the same shape helpers and
+    resident-arg order the lowering uses. The persistent AOT cache
+    checks a loaded executable's ``args_info`` against this, so even a
+    fingerprint collision cannot put a mismatched program on the
+    dispatch path."""
+    q_pad, q_tile = bucket_shapes(index, cfg, bucket)
+    acc = str(jnp.dtype(_acc_dtype(cfg)))
+    i32 = "int32"
+    b = index.backend
+    if b in ("serial", "ivf", "ivf-sharded"):
+        qt = q_pad // q_tile
+        qdt = str(jnp.dtype(cfg.dtype)) if b == "serial" else "float32"
+        carry = acc if b == "serial" else "float32"
+        args = [
+            ((qt, q_tile, index.dim), qdt),
+            ((qt, q_tile), i32),
+            ((qt, q_tile, cfg.k), carry),
+            ((qt, q_tile, cfg.k), i32),
+        ]
+        if b == "ivf-sharded":
+            from mpi_knn_tpu.ivf.sharded import N_STATS
+
+            args.append(((N_STATS * index.shards,), i32))
+    else:
+        qdt = "float32" if b == "pallas" else str(jnp.dtype(cfg.dtype))
+        carry = "float32" if b == "pallas" else acc
+        args = [
+            ((q_pad, index.dim), qdt),
+            ((q_pad,), i32),
+            ((q_pad, cfg.k), carry),
+            ((q_pad, cfg.k), i32),
+        ]
+    args.extend(
+        (tuple(int(s) for s in a.shape), str(a.dtype))
+        for a in _resident_args(index)
+        if a is not None
+    )
+    return args
+
+
+def _serial_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
+    q_pad, q_tile = _serial_bucket_shapes(index, cfg, bucket)
     qt = q_pad // q_tile
     acc = _acc_dtype(cfg)
     dtype = jnp.dtype(cfg.dtype)
@@ -232,9 +352,7 @@ def _serial_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
         sds((qt, q_tile), jnp.int32),
         sds((qt, q_tile, cfg.k), acc),
         sds((qt, q_tile, cfg.k), jnp.int32),
-        index.tiles,
-        index.tile_ids,
-        index.tile_sqs,
+        *_resident_args(index),
         cfg,
     )
     return lowered, q_pad, q_tile
@@ -270,9 +388,7 @@ def _ring_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
         sds((q_pad,), jnp.int32, sharding=qsh),
         sds((q_pad, cfg.k), acc, sharding=qsh),
         sds((q_pad, cfg.k), jnp.int32, sharding=qsh),
-        index.corpus_sharded,
-        index.corpus_ids_sharded,
-        index.corpus_scales_sharded,
+        *_resident_args(index),
         cfg,
         index.backend == "ring-overlap",
         index.mesh,
@@ -285,9 +401,7 @@ def _ring_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
 
 
 def _pallas_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
-    q_tile = min(max(8, pad_to_multiple(cfg.query_tile, 8)), 512,
-                 pad_to_multiple(bucket, 8))
-    q_pad = pad_to_multiple(bucket, q_tile)
+    q_pad, q_tile = _pallas_bucket_shapes(index, cfg, bucket)
     variant = cfg.pallas_variant
     if variant == "sweep" and cfg.k > index.c_tile:
         variant = "tiles"  # same corner routing as all_knn_pallas
@@ -297,7 +411,7 @@ def _pallas_lowered(index: CorpusIndex, cfg: KNNConfig, bucket: int):
         sds((q_pad,), jnp.int32),
         sds((q_pad, cfg.k), jnp.float32),
         sds((q_pad, cfg.k), jnp.int32),
-        index.corpus_padded,
+        *_resident_args(index),
         cfg,
         q_tile,
         index.c_tile,
@@ -326,12 +440,7 @@ def _ivf_lowered(index, cfg: KNNConfig, bucket: int):
         sds((qt, q_tile), jnp.int32),
         sds((qt, q_tile, cfg.k), jnp.float32),
         sds((qt, q_tile, cfg.k), jnp.int32),
-        index.centroids,
-        index.centroid_sqs,
-        index.buckets,
-        index.bucket_ids,
-        index.bucket_sqs,
-        index.bucket_scales,
+        *_resident_args(index),
         cfg,
         nprobe,
     )
@@ -357,12 +466,7 @@ def _ivf_sharded_lowered(index, cfg: KNNConfig, bucket: int):
         sds((qt, q_tile, cfg.k), jnp.float32, sharding=qsh),
         sds((qt, q_tile, cfg.k), jnp.int32, sharding=qsh),
         sds((N_STATS * index.shards,), jnp.int32, sharding=qsh),
-        index.centroids,
-        index.centroid_sqs,
-        index.buckets,
-        index.bucket_ids,
-        index.bucket_sqs,
-        index.bucket_scales,
+        *_resident_args(index),
         cfg,
         nprobe,
         index.mesh,
@@ -409,115 +513,201 @@ def _fingerprint_cfg(cfg: KNNConfig) -> KNNConfig:
     return cfg.replace(dispatch_depth=1, query_bucket=1)
 
 
+# per-(index, cell) compile locks so a parallel warm pool (and a live
+# dispatch racing it) compiles each distinct cell exactly once; the lock
+# map lives on the index instance (``__dict__``-attached, like ``_cache``
+# a per-index mutable) and the tiny module mutex only guards map access
+_KEYLOCK_MUTEX = threading.Lock()
+
+
+def _key_lock(index, key) -> threading.Lock:
+    with _KEYLOCK_MUTEX:
+        locks = index.__dict__.setdefault("_cache_key_locks", {})
+        lk = locks.get(key)
+        if lk is None:
+            lk = locks[key] = threading.Lock()
+        return lk
+
+
 def get_executable(
     index: CorpusIndex, cfg: KNNConfig, bucket: int
 ) -> _BucketExec:
-    """The (bucket, config) executable, compiled at most once per index.
-    The frozen config is the fingerprint (host-only pacing knobs
-    canonicalized out) — two configs differing in any field that reaches
-    the lowering (k, topk method, precision policy, donation, …) occupy
-    distinct cells and can never serve each other's programs."""
+    """The (bucket, config) executable, built at most once per index —
+    revived from the persistent AOT cache when one is active
+    (``serve.aotcache``; a hit skips trace, lowering AND the XLA compile),
+    compiled otherwise. The frozen config is the fingerprint (host-only
+    pacing knobs canonicalized out) — two configs differing in any field
+    that reaches the lowering (k, topk method, precision policy,
+    donation, …) occupy distinct cells and can never serve each other's
+    programs; the on-disk key extends the same fingerprint with the index
+    facts, platform topology, and jax version (``aotcache.fingerprint``).
+    Thread-safe per cell: concurrent callers of the same cell serialize
+    on a per-key lock (one compile), distinct cells build in parallel
+    (the warm pool's whole point)."""
     key = (bucket, _fingerprint_cfg(cfg))
     exec_ = index._cache.get(key)
-    if exec_ is None:
-        # the central compile capture must be live BEFORE the compile it
-        # is supposed to count (idempotent; jax is already imported here)
-        obs_metrics.install_jax_compile_listener()
-        sid = obs_spans.begin_span(
-            "compile", cat="compile", bucket=bucket, backend=index.backend,
-            policy=cfg.precision_policy,
-        )
-        try:
-            lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
-            qsh = None
-            route_cap = exchange_bytes = None
-            if index.backend in ("ring", "ring-overlap"):
-                from mpi_knn_tpu.backends.ring import _query_spec
+    if exec_ is not None:
+        return exec_
+    with _key_lock(index, key):
+        exec_ = index._cache.get(key)
+        if exec_ is None:
+            exec_ = _build_executable(index, cfg, bucket)
+            index._cache[key] = exec_
+    return exec_
 
-                q_axis = index.ring_meta[0]
-                qsh = NamedSharding(
-                    index.mesh, _query_spec(q_axis, index.ring_meta[1])
-                )
-            qids = jnp.full((q_pad,), -1, jnp.int32)
-            make_carry = None
-            if qsh is not None:
-                qids = jax.device_put(qids, qsh)
-                make_carry = jax.jit(
-                    functools.partial(
-                        init_topk, q_pad, cfg.k, dtype=_acc_dtype(cfg)
-                    ),
-                    out_shardings=(qsh, qsh),
-                )
-            if index.backend == "ivf-sharded":
-                from jax.sharding import PartitionSpec
-                from mpi_knn_tpu.ivf.sharded import (
-                    exchange_bytes_per_tile,
-                    exchange_wire_args,
-                    scratch_maker,
-                    sharded_query_shapes,
-                )
 
-                qsh = NamedSharding(index.mesh, PartitionSpec(index.axis))
-                qt = q_pad // q_tile
-                _, _, route_cap = sharded_query_shapes(
-                    cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket,
-                    index.shards,
-                )
-                wire_dim, wire_itemsize, wire_scale = exchange_wire_args(
-                    index
-                )
-                exchange_bytes = qt * exchange_bytes_per_tile(
-                    index.shards, route_cap, index.bucket_cap, wire_dim,
-                    wire_itemsize, wire_scale,
-                )
-                qids = jax.device_put(
-                    jnp.full((qt, q_tile), -1, jnp.int32), qsh
-                )
-                make_carry = scratch_maker(
-                    qt, q_tile, cfg.k, index.shards, index.mesh, index.axis
-                )
-            exec_ = _BucketExec(
-                lowered.compile(), bucket, q_pad, q_tile, cfg, index.backend,
-                q_sharding=qsh, qids=qids, make_carry=make_carry,
-                route_cap=route_cap, exchange_bytes=exchange_bytes,
+def _build_executable(
+    index: CorpusIndex, cfg: KNNConfig, bucket: int
+) -> _BucketExec:
+    from mpi_knn_tpu.serve import aotcache
+
+    # the central compile capture must be live BEFORE the compile it
+    # is supposed to count (idempotent; jax is already imported here)
+    obs_metrics.install_jax_compile_listener()
+    disk = aotcache.active_cache()
+    cache_mode = "off"
+    sid = obs_spans.begin_span(
+        "compile", cat="compile", bucket=bucket, backend=index.backend,
+        policy=cfg.precision_policy,
+    )
+    try:
+        compiled = None
+        fp = None
+        if disk is not None:
+            # the signature check rebuilds the cell's argspec from pure
+            # shape math — a hit never lowers anything
+            fp = aotcache.fingerprint(index, cfg, bucket)
+            compiled = disk.load(
+                fp, expect_args=expected_args(index, cfg, bucket)
             )
-        except Exception as e:
-            # a raised lowering/compile failure is survivable by the
-            # caller — close the span with the error; an OPEN compile
-            # span must stay what the contract says: a kill diagnosis
-            obs_spans.end_span(sid, error=type(e).__name__)
-            raise
-        index._cache[key] = exec_
-        obs_spans.end_span(sid)
-        reg = obs_metrics.get_registry()
+            cache_mode = "hit" if compiled is not None else "miss"
+        if compiled is not None:
+            q_pad, q_tile = bucket_shapes(index, cfg, bucket)
+        else:
+            lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
+            compiled = lowered.compile()
+            if disk is not None:
+                # best-effort (a full disk must not fail serving); meta
+                # carries the readable fingerprint for doctor/forensics
+                disk.store(
+                    fp, compiled,
+                    meta=aotcache.fingerprint_facts(index, cfg, bucket),
+                )
+        exec_ = _finish_executable(
+            index, cfg, bucket, compiled, q_pad, q_tile,
+            source="cache-hit" if cache_mode == "hit" else "compiled",
+        )
+    except Exception as e:
+        # a raised lowering/compile failure is survivable by the
+        # caller — close the span with the error; an OPEN compile
+        # span must stay what the contract says: a kill diagnosis
+        obs_spans.end_span(sid, error=type(e).__name__)
+        raise
+    obs_spans.end_span(sid, cache=cache_mode)
+    reg = obs_metrics.get_registry()
+    if exec_.source == "cache-hit":
+        reg.counter(
+            "serve_executables_loaded_total",
+            help="(bucket, config) cells revived from the persistent AOT "
+            "cache (zero XLA compiles)",
+        ).inc()
+    else:
         reg.counter(
             "serve_executables_compiled_total",
             help="(bucket, config) cells compiled by the serve cache",
         ).inc()
-        # compression-ladder gauges, stamped at LOWER time (pure shape
-        # math, no device reads — the sharded exchange-bytes precedent):
-        # the 2×/4×/8× byte cuts of bf16/int8 transfer and bf16/int8/int4
-        # at-rest stores are visible in `mpi-knn metrics` / `--report`
-        # next to the recall they paid.
-        if index.backend in ("ring", "ring-overlap"):
-            from mpi_knn_tpu.backends.ring import ring_wire_bytes_per_batch
+    # compression-ladder gauges, stamped at LOWER time (pure shape
+    # math, no device reads — the sharded exchange-bytes precedent):
+    # the 2×/4×/8× byte cuts of bf16/int8 transfer and bf16/int8/int4
+    # at-rest stores are visible in `mpi-knn metrics` / `--report`
+    # next to the recall they paid.
+    if index.backend in ("ring", "ring-overlap"):
+        from mpi_knn_tpu.backends.ring import ring_wire_bytes_per_batch
 
-            ring_n = index.ring_meta[3]
-            reg.gauge(
-                "ring_transfer_wire_bytes",
-                help="bytes one batch's full corpus rotation moves over "
-                "the interconnect, at the wire dtype (static per "
-                "executable)",
-            ).set(ring_wire_bytes_per_batch(
-                cfg, index.corpus_sharded.shape[0], index.dim, ring_n,
-            ))
-        if index.backend in ("ivf", "ivf-sharded"):
-            reg.gauge(
-                "ivf_at_rest_bytes",
-                help="resident bytes of the clustered bucket store "
-                "(codes + scales for quantized stores)",
-            ).set(index.nbytes_resident)
+        ring_n = index.ring_meta[3]
+        reg.gauge(
+            "ring_transfer_wire_bytes",
+            help="bytes one batch's full corpus rotation moves over "
+            "the interconnect, at the wire dtype (static per "
+            "executable)",
+        ).set(ring_wire_bytes_per_batch(
+            cfg, index.corpus_sharded.shape[0], index.dim, ring_n,
+        ))
+    if index.backend in ("ivf", "ivf-sharded"):
+        reg.gauge(
+            "ivf_at_rest_bytes",
+            help="resident bytes of the clustered bucket store "
+            "(codes + scales for quantized stores)",
+        ).set(index.nbytes_resident)
     return exec_
+
+
+def _finish_executable(
+    index, cfg: KNNConfig, bucket: int, compiled, q_pad: int, q_tile: int,
+    source: str,
+) -> _BucketExec:
+    """Wrap a ready executable (freshly compiled OR revived from disk)
+    with the dispatch-side state every batch needs — query shardings,
+    the constant query-id vector, the carry initializer, the sharded
+    exchange accounting. All of it is shape math and small device
+    constants, none of it needs the lowering."""
+    qsh = None
+    route_cap = exchange_bytes = None
+    if index.backend in ("ring", "ring-overlap"):
+        from mpi_knn_tpu.backends.ring import _query_spec
+
+        q_axis = index.ring_meta[0]
+        qsh = NamedSharding(
+            index.mesh, _query_spec(q_axis, index.ring_meta[1])
+        )
+    # the constant query-id vector is built in numpy and device_put (a
+    # transfer, never an XLA program): on a persistent-cache hit the
+    # whole cell build must count ZERO backend compiles, and an eager
+    # jnp.full here would compile a tiny fill executable
+    qids = jax.device_put(np.full((q_pad,), -1, np.int32))
+    make_carry = None
+    if qsh is not None:
+        qids = jax.device_put(np.full((q_pad,), -1, np.int32), qsh)
+        make_carry = jax.jit(
+            functools.partial(
+                init_topk, q_pad, cfg.k, dtype=_acc_dtype(cfg)
+            ),
+            out_shardings=(qsh, qsh),
+        )
+    if index.backend == "ivf-sharded":
+        from jax.sharding import PartitionSpec
+        from mpi_knn_tpu.ivf.sharded import (
+            exchange_bytes_per_tile,
+            exchange_wire_args,
+            scratch_maker,
+            sharded_query_shapes,
+        )
+
+        qsh = NamedSharding(index.mesh, PartitionSpec(index.axis))
+        qt = q_pad // q_tile
+        _, _, route_cap = sharded_query_shapes(
+            cfg, cfg.nprobe, index.bucket_cap, index.dim, bucket,
+            index.shards,
+        )
+        wire_dim, wire_itemsize, wire_scale = exchange_wire_args(
+            index
+        )
+        exchange_bytes = qt * exchange_bytes_per_tile(
+            index.shards, route_cap, index.bucket_cap, wire_dim,
+            wire_itemsize, wire_scale,
+        )
+        qids = jax.device_put(
+            np.full((qt, q_tile), -1, np.int32), qsh
+        )
+        make_carry = scratch_maker(
+            qt, q_tile, cfg.k, index.shards, index.mesh, index.axis
+        )
+    return _BucketExec(
+        compiled, bucket, q_pad, q_tile, cfg, index.backend,
+        q_sharding=qsh, qids=qids, make_carry=make_carry,
+        route_cap=route_cap, exchange_bytes=exchange_bytes,
+        source=source,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -598,9 +788,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             qids.reshape(qt, exec_.q_tile),
             carry_d,
             carry_i,
-            index.tiles,
-            index.tile_ids,
-            index.tile_sqs,
+            *_resident_args(index),
         )
         return (
             d.reshape(exec_.q_pad, cfg.k),
@@ -617,12 +805,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
             qids.reshape(qt, exec_.q_tile),
             carry_d,
             carry_i,
-            index.centroids,
-            index.centroid_sqs,
-            index.buckets,
-            index.bucket_ids,
-            index.bucket_sqs,
-            index.bucket_scales,
+            *_resident_args(index),
         )
         return (
             d.reshape(exec_.q_pad, cfg.k),
@@ -633,9 +816,7 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
         # q2d arrives pre-tiled (QT, q_tile, d) on the query sharding
         carry_d, carry_i, stats0 = exec_.make_carry()
         d, i, stats = exec_.compiled(
-            q2d, qids, carry_d, carry_i, stats0,
-            index.centroids, index.centroid_sqs, index.buckets,
-            index.bucket_ids, index.bucket_sqs, index.bucket_scales,
+            q2d, qids, carry_d, carry_i, stats0, *_resident_args(index),
         )
         return (
             d.reshape(exec_.q_pad, cfg.k),
@@ -648,14 +829,12 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
         # executable consumes them (donation)
         carry_d, carry_i = exec_.make_carry()
         d, i = exec_.compiled(
-            q2d, qids, carry_d, carry_i,
-            index.corpus_sharded, index.corpus_ids_sharded,
-            index.corpus_scales_sharded,
+            q2d, qids, carry_d, carry_i, *_resident_args(index),
         )
         return d, i, None
     carry_d, carry_i = init_topk(exec_.q_pad, cfg.k, dtype=acc)
     d, i = exec_.compiled(
-        q2d, qids, carry_d, carry_i, index.corpus_padded
+        q2d, qids, carry_d, carry_i, *_resident_args(index)
     )
     return d, i, None
 
@@ -858,6 +1037,12 @@ class ServeSession:
         self._rung = 0
         self._consecutive_breaches = 0
         self._seq = 0
+        # cold-start readiness (ISSUE 12): warm() publishes per-cell
+        # progress here — /healthz's warming block and the front end's
+        # per-bucket admission read it (possibly from other threads)
+        self._warm_lock = threading.Lock()
+        self.warm_state: dict = {"total": 0, "ready": 0, "done": True}
+        self.warm_report: dict | None = None
         self._inflight: collections.deque = collections.deque()
         self.latencies: list[float] = []
         self.queries_served = 0
@@ -887,22 +1072,136 @@ class ServeSession:
         """The ladder rung new submissions dispatch under."""
         return self.ladder[self._rung][0]
 
-    def warm(self, sizes) -> None:
-        """Pre-compile the executables for the given batch sizes — at
+    def warm(self, sizes, parallel: int | None = None,
+             progress=None) -> dict:
+        """Pre-build the executables for the given batch sizes — at
         EVERY ladder rung, not just the configured one: the first batch
         after a degradation lands at the moment of overload, and a cold
         compile there would itself breach the deadline and cascade the
         session further down the ladder on compile latency, not load.
-        (Rungs whose program coincides with an already-compiled cell —
-        a halved bucket that pads a given size to the same row count —
-        hit the cache and cost nothing.)"""
+
+        Cold-start machinery (ISSUE 12):
+
+        - cells are DEDUPED by executable fingerprint before anything
+          lowers — rungs whose frozen config resolves to an identical
+          program at the same bucket (e.g. the ``bucket/2`` rung when a
+          size pads to the same row count) occupy one cell, so the
+          dedupe saves compiles even with the persistent cache disabled;
+        - distinct cells build across a thread pool (XLA releases the
+          GIL during compilation; ``parallel=None`` sizes the pool to
+          min(cells, cpu count), ``parallel=1`` forces the old
+          sequential walk). Per-cell "compile" spans carry a ``cache``
+          attr (hit/miss/off) and the aot hit/miss counters land in the
+          registry, so a warm's cache story is machine-readable;
+        - per-cell progress feeds ``warm_state`` (ready / total — the
+          ``/healthz`` warming block) and the optional
+          ``progress(ready, total, bucket)`` callback, called from pool
+          threads as each executable lands.
+
+        Returns a report: ``{cells, raw_cells, deduped, compiled,
+        loaded, reused, wall_s}`` where ``loaded`` counts cells revived
+        from the persistent AOT cache and ``reused`` cells that were
+        already in memory before this warm."""
+        t0 = time.perf_counter()
+        raw: list = []
+        for n in sizes:
+            for _, cfg in self.ladder:
+                raw.append((bucket_rows(n, cfg.query_bucket), cfg))
+        distinct: dict = {}
+        for bucket, cfg in raw:
+            distinct.setdefault((bucket, _fingerprint_cfg(cfg)),
+                                (bucket, cfg))
+        cells = list(distinct.values())
+        total = len(cells)
+        with self._warm_lock:
+            self.warm_state = {"total": total, "ready": 0, "done": False}
+        workers = (
+            max(1, min(total, os.cpu_count() or 1))
+            if parallel is None else max(1, parallel)
+        )
+
+        def _one(cell):
+            bucket, cfg = cell
+            existed = (bucket, _fingerprint_cfg(cfg)) in self.index._cache
+            exec_ = get_executable(self.index, cfg, bucket)
+            with self._warm_lock:
+                self.warm_state["ready"] += 1
+                ready = self.warm_state["ready"]
+            if progress is not None:
+                progress(ready, total, bucket)
+            return existed, exec_
+
         with obs_spans.span("warm", cat="serve", sizes=list(sizes),
-                            rungs=len(self.ladder)):
-            for n in sizes:
-                for _, cfg in self.ladder:
-                    get_executable(
-                        self.index, cfg, bucket_rows(n, cfg.query_bucket)
-                    )
+                            rungs=len(self.ladder), cells=total,
+                            deduped=len(raw) - total, workers=workers):
+            if workers <= 1 or total <= 1:
+                built = [_one(c) for c in cells]
+            else:
+                with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="tknn-warm",
+                ) as pool:
+                    built = list(pool.map(_one, cells))
+        with self._warm_lock:
+            self.warm_state["done"] = True
+        report = {
+            "cells": total,
+            "raw_cells": len(raw),
+            "deduped": len(raw) - total,
+            "reused": sum(1 for existed, _ in built if existed),
+            "loaded": sum(
+                1 for existed, e in built
+                if not existed and e.source == "cache-hit"
+            ),
+            "compiled": sum(
+                1 for existed, e in built
+                if not existed and e.source == "compiled"
+            ),
+            "wall_s": round(time.perf_counter() - t0, 4),
+        }
+        self.warm_report = report
+        return report
+
+    def warm_async(self, sizes, parallel: int | None = None,
+                   progress=None) -> threading.Thread:
+        """Run :meth:`warm` on a background thread (the serve CLI's
+        bind-the-port-first startup): returns the started daemon thread;
+        ``warm_state``/``bucket_ready`` expose progress to ``/healthz``
+        and the front end's per-bucket admission while it runs."""
+        t = threading.Thread(
+            target=self.warm, args=(sizes, parallel, progress),
+            name="tknn-warm-async", daemon=True,
+        )
+        t.start()
+        return t
+
+    def bucket_ready(self, rows: int) -> bool:
+        """Whether a batch of exactly ``rows`` rows would dispatch on an
+        already-built executable at the CURRENT ladder rung."""
+        _, cfg = self.ladder[self._rung]
+        key = (bucket_rows(max(1, rows), cfg.query_bucket),
+               _fingerprint_cfg(cfg))
+        return key in self.index._cache
+
+    def coalesced_ready(self, rows: int, max_rows: int) -> bool:
+        """The front end's per-bucket admission signal while warming: a
+        request of ``rows`` rows admitted into a coalescer that fills up
+        to ``max_rows`` can land in ANY power-of-two bucket between its
+        own and the fill target's — gating on the request's own bucket
+        alone would let admitted requests coalesce into a larger, still-
+        cold bucket and compile inline on the dispatch pump (exactly the
+        stall the 503 "warming" refusal exists to prevent). True iff
+        every bucket in that span is built at the current rung."""
+        _, cfg = self.ladder[self._rung]
+        fp = _fingerprint_cfg(cfg)
+        b = bucket_rows(max(1, rows), cfg.query_bucket)
+        top = bucket_rows(max(1, max(rows, max_rows)), cfg.query_bucket)
+        while True:
+            if (b, fp) not in self.index._cache:
+                return False
+            if b >= top:
+                return True
+            b *= 2
 
     def reset_stats(self) -> None:
         """Start a fresh measurement window. The exact contract (tested

@@ -201,6 +201,20 @@ else
     fail=1
 fi
 
+note "cold-start gate (ISSUE 12: persistent AOT executable cache)"
+# start the production `mpi-knn serve` TWICE against one --cache-dir:
+# the second start must report aot_cache_hits_total > 0 and ZERO
+# serve-cache compiles in /metrics (every executable revived from disk,
+# the corrupt-entry path counted separately and required silent), and
+# its healthz-ready wall time must be under the cold start's. The
+# bit-identity and corruption-fallback CONTRACT is tier-1
+# (tests/test_aot_cache.py); this gate proves the restart story end to
+# end through the CLIs, where a fingerprint or serialization regression
+# fails by name. (The lint sweeps above can share compiled artifacts
+# the same way via `mpi-knn lint --cache-dir` — jax's own compilation
+# cache, see analysis/README.md.)
+timeout -k 10 420 python scripts/check_cold_start.py || fail=1
+
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""CI gate for the persistent AOT executable cache (ISSUE 12).
+
+Starts the production ``mpi-knn serve`` TWICE against one ``--cache-dir``
+and holds the cold-start contract as observable facts of the second
+process, never of this driver's imports:
+
+- second start reports ``aot_cache_hits_total > 0`` in ``/metrics``
+  (executables revived from disk);
+- second start reports ZERO serve-cache compiles
+  (``serve_executables_compiled_total`` absent or 0 — every cell loaded);
+- second start's healthz-ready wall time (process spawn →
+  ``/healthz`` ``ready: true``) is under the cold start's.
+
+Each server binds an ephemeral port, writes a ready file, and is driven
+over HTTP exactly as an operator would — the gate fails loudly with the
+measured numbers either way.
+
+Usage::
+
+    python scripts/check_cold_start.py [--data synthetic:2048x32c4]
+        [--bucket 128] [--timeout 180]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # run as `python scripts/check_cold_start.py`
+
+
+def _wait_ready(ready_file: pathlib.Path, proc, timeout_s: float) -> str:
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        if ready_file.is_file() and ready_file.read_text().strip():
+            return ready_file.read_text().strip()
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server exited rc={proc.returncode} before binding"
+            )
+        time.sleep(0.05)
+    raise RuntimeError(f"server did not bind within {timeout_s}s")
+
+
+def _wait_healthz(url: str, timeout_s: float) -> float:
+    """Seconds until /healthz reports ready (polled from call time)."""
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+                st = json.load(r)
+            if st.get("ready"):
+                return time.perf_counter() - t0
+        except OSError:
+            pass
+        time.sleep(0.05)
+    raise RuntimeError(f"/healthz never reported ready within {timeout_s}s")
+
+
+def _scrape(url: str) -> dict:
+    from mpi_knn_tpu.obs.metrics import parse_prometheus
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def _one_start(label: str, args, cache_dir: str, tmp: pathlib.Path):
+    """(ready_wall_s, metrics_samples) of one full server start."""
+    ready_file = tmp / f"ready-{label}"
+    ready_file.unlink(missing_ok=True)
+    cmd = [
+        sys.executable, "-m", "mpi_knn_tpu", "serve",
+        "--data", args.data, "--k", "10", "--backend", "serial",
+        "--bucket", str(args.bucket), "--corpus-tile", "512",
+        "--port", "0", "--ready-file", str(ready_file),
+        "--cache-dir", cache_dir, "-q",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT, start_new_session=True,
+    )
+    try:
+        url = _wait_ready(ready_file, proc, args.timeout)
+        _wait_healthz(url, args.timeout)
+        ready_wall = time.perf_counter() - t0
+        samples = _scrape(url)
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=30)
+    return ready_wall, samples
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--data", default="synthetic:2048x32c4")
+    ap.add_argument("--bucket", type=int, default=128)
+    ap.add_argument("--timeout", type=float, default=180.0)
+    args = ap.parse_args(argv)
+
+    with tempfile.TemporaryDirectory(prefix="tknn-coldstart-") as td:
+        tmp = pathlib.Path(td)
+        cache_dir = str(tmp / "aot")
+
+        cold_s, cold_m = _one_start("cold", args, cache_dir, tmp)
+        stored = cold_m.get("aot_cache_stores_total", 0)
+        if not stored:
+            print(f"cold-start gate: FIRST start stored no cache entries "
+                  f"(samples: {sorted(k for k in cold_m if 'aot' in k)})")
+            return 1
+
+        warm_s, warm_m = _one_start("cached", args, cache_dir, tmp)
+        hits = warm_m.get("aot_cache_hits_total", 0)
+        compiles = warm_m.get("serve_executables_compiled_total", 0)
+        errors = warm_m.get("aot_cache_errors_total", 0)
+
+        ok = True
+        if hits <= 0:
+            print(f"cold-start gate: second start reported no cache hits "
+                  f"(hits={hits})")
+            ok = False
+        if compiles != 0:
+            print("cold-start gate: second start still compiled "
+                  f"{compiles:.0f} serve cell(s)")
+            ok = False
+        if errors:
+            print(f"cold-start gate: cache errors counted ({errors:.0f})")
+            ok = False
+        if warm_s >= cold_s:
+            print("cold-start gate: cached start was not faster "
+                  f"(cold {cold_s:.2f}s vs cached {warm_s:.2f}s)")
+            ok = False
+        print(
+            f"cold-start gate: cold ready {cold_s:.2f}s "
+            f"({stored:.0f} entries stored) → cached ready {warm_s:.2f}s "
+            f"({hits:.0f} hits, {compiles:.0f} compiles, "
+            f"{cold_s / warm_s:.1f}x)"
+        )
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
