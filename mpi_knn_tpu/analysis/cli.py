@@ -60,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "accounting) or int8/int4 (the clustered store's "
                    "at-rest levels — R2's wire-priced gather bound); "
                    "repeatable")
+    p.add_argument("--host", action="store_true",
+                   help="run the HOST concurrency lint instead (lock "
+                   "discipline / lock ordering / thread confinement / "
+                   "atomic publication over the threaded host modules "
+                   "— analysis/host; jax-free, writes "
+                   "host_report.json). All other flags are the host "
+                   "linter's own (--rule/--out/--list-rules/-q)")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -85,6 +92,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--host" in argv:
+        # the host concurrency lint (lock discipline / confinement over
+        # the threaded host modules) is a separate, jax-free analyzer:
+        # route before the HLO parser so neither namespace pays for the
+        # other (and --host never forces a platform or imports jax)
+        from mpi_knn_tpu.analysis.host.cli import main as host_main
+
+        return host_main([a for a in argv if a != "--host"])
     args = build_parser().parse_args(argv)
 
     if args.list_rules:

@@ -1,0 +1,58 @@
+"""Host-side concurrency lint — lock discipline, lock ordering, thread
+confinement, and atomic publication for the THREADED host modules.
+
+The HLO lint engine (``mpi_knn_tpu.analysis``, rules R1–R6) machine-
+checks every compiled device program; this package is its host-layer
+dual: an AST/call-graph static analyzer over the modules that carry the
+serving stack's threads (the frontend dispatch pump and HTTP handlers,
+the parallel warm pool, concurrent AOT-cache writers, the process-wide
+metrics registry and span recorder, the worker supervisor). A latent
+race there silently corrupts the very counters, flight records and
+cache entries the whole verification story is built on.
+
+Rules (see ``rules.py``):
+
+- **H1 lock discipline** — every shared mutable attribute of a
+  thread-crossing class is declared in a per-class guard map, and every
+  access site is statically inside a ``with <its-lock>:`` scope (or a
+  declared-confined method). Undeclared attributes touched from two or
+  more thread roots are findings — the map is enforced, not advisory.
+- **H2 lock ordering** — the static lock-acquisition graph (nested
+  ``with`` scopes propagated through the call graph) must be acyclic.
+- **H3 thread confinement** — attributes declared confined to one
+  thread root must be unreachable from any other root's call graph.
+- **H4 atomic publish** — file writes in threaded modules flow through
+  the atomic temp+``os.replace`` helper (``utils.atomicio``) or carry
+  their own ``os.replace``; a bare ``open(..., "w")`` is a finding.
+
+Entry point: ``mpi-knn lint --host`` → ``artifacts/lint/host_report.json``
+(``engine.run_host_lint`` programmatically — tests feed deliberately
+broken fixture modules through the same path, the repo's convention
+since R1). ``witness.py`` is the runtime side: an instrumented lock
+wrapper recording acquisition order and guard violations, armed in
+tests only.
+
+Jax-free and import-light by construction: the analyzer reads source
+text; it never imports the modules it checks.
+"""
+
+from mpi_knn_tpu.analysis.host.engine import HostReport, run_host_lint
+from mpi_knn_tpu.analysis.host.guards import (
+    ClassGuard,
+    GuardMap,
+    HostTarget,
+    default_guards,
+    default_targets,
+)
+from mpi_knn_tpu.analysis.host.rules import HostFinding
+
+__all__ = [
+    "ClassGuard",
+    "GuardMap",
+    "HostFinding",
+    "HostReport",
+    "HostTarget",
+    "default_guards",
+    "default_targets",
+    "run_host_lint",
+]

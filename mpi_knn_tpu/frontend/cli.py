@@ -237,8 +237,13 @@ def serve_main(argv=None) -> int:
         )
         print(f"[mpi-knn serve] listening on {server.url}", flush=True)
     if args.ready_file:
-        with open(args.ready_file, "w") as f:
-            f.write(server.url + "\n")
+        # atomic publish (utils.atomicio, host-lint rule H4): the CI
+        # gate polls this file from another process while it is being
+        # written — it must read nothing or the full URL, never a
+        # truncated prefix
+        from mpi_knn_tpu.utils.atomicio import atomic_write_text
+
+        atomic_write_text(args.ready_file, server.url + "\n")
 
     def _report_warm():
         frontend._serving_ready.wait()
@@ -271,10 +276,12 @@ def serve_main(argv=None) -> int:
         frontend.stop()
         if args.metrics_out:
             from mpi_knn_tpu.obs.metrics import get_registry
+            from mpi_knn_tpu.utils.atomicio import atomic_write_text
 
-            with open(args.metrics_out, "w") as f:
-                json.dump(get_registry().snapshot(), f, indent=1)
-                f.write("\n")
+            atomic_write_text(
+                args.metrics_out,
+                json.dumps(get_registry().snapshot(), indent=1) + "\n",
+            )
         if not args.quiet:
             st = frontend.stats()
             print(
@@ -370,14 +377,14 @@ def loadgen_main(argv=None) -> int:
               file=sys.stderr)
         return 1
     if args.report:
-        with open(args.report, "w") as f:
-            json.dump({
-                "schema": "mpi_knn_tpu.frontend.loadgen/1",
-                "url": args.url,
-                "health": health,
-                "rows": rows_out,
-            }, f, indent=1)
-            f.write("\n")
+        from mpi_knn_tpu.utils.atomicio import atomic_write_text
+
+        atomic_write_text(args.report, json.dumps({
+            "schema": "mpi_knn_tpu.frontend.loadgen/1",
+            "url": args.url,
+            "health": health,
+            "rows": rows_out,
+        }, indent=1) + "\n")
         if not args.quiet:
             print(f"report written to {args.report}")
     return 0

@@ -230,8 +230,11 @@ class Frontend:
             # per-bucket admission while warming (ISSUE 12): traffic
             # whose executable has landed serves immediately; the rest
             # are refused with the warming progress, not queued behind a
-            # compile that would blow their deadline anyway
-            ws = dict(self.session.warm_state)
+            # compile that would blow their deadline anyway. The
+            # progress copy goes through warm_snapshot(): a bare
+            # dict(warm_state) here raced the warm pool's per-cell
+            # updates under the session's OWN lock (host-lint H1)
+            ws = self.session.warm_snapshot()
             return Rejection(
                 tenant=str(tenant), reason="warming",
                 detail=(
@@ -260,10 +263,19 @@ class Frontend:
             return ticket
 
     def stats(self) -> dict:
-        """The health/posture snapshot ``GET /healthz`` serves."""
+        """The health/posture snapshot ``GET /healthz`` serves.
+
+        Session state comes through the session's OWN locked snapshots
+        (``warm_snapshot``/``stats_snapshot``), taken BEFORE the
+        frontend lock: handler threads previously read ``ses.latencies``
+        / ``ses.tenant_stats`` raw while the pump mutated them at
+        retire — the exact guard-map breach host-lint H1 flags — and
+        keeping the two critical sections disjoint also keeps the lock
+        graph free of a Frontend→Session edge from this path."""
         ses = self.session
+        warm = ses.warm_snapshot()
+        posture = ses.stats_snapshot()
         with self._lock:
-            warm = dict(ses.warm_state)
             return {
                 "ok": self._crashed is None,
                 # cold-start posture (ISSUE 12): executables ready/total
@@ -280,13 +292,13 @@ class Frontend:
                 "queue_requests": self.scheduler.coalescer.pending_requests,
                 "admitted": self.scheduler.admitted,
                 "rejected": self.scheduler.rejected,
-                "rung": ses.rung,
+                "rung": posture["rung"],
                 "ladder": [label for label, _ in ses.ladder],
                 "sheds": len(self.scheduler.sheds),
                 "recoveries": len(self.scheduler.recoveries),
-                "batches_retired": len(ses.latencies),
-                "queries_served": ses.queries_served,
-                "tenants": sorted(ses.tenant_stats),
+                "batches_retired": posture["batches_retired"],
+                "queries_served": posture["queries_served"],
+                "tenants": posture["tenants"],
                 # what a load generator needs to shape requests
                 "dim": ses.index.dim,
                 "k": ses.cfg.k,

@@ -107,10 +107,16 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self) -> dict:
-        return {"kind": self.kind, "help": self.help, "value": self._value}
+        # under the lock (host-lint H1): /metrics scrapes race inc()
+        # from serving threads, and an unguarded read here is the torn-
+        # snapshot bug the host concurrency lint exists to catch
+        with self._lock:
+            return {"kind": self.kind, "help": self.help,
+                    "value": self._value}
 
 
 class Gauge:
@@ -138,10 +144,13 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self) -> dict:
-        return {"kind": self.kind, "help": self.help, "value": self._value}
+        with self._lock:
+            return {"kind": self.kind, "help": self.help,
+                    "value": self._value}
 
 
 class Histogram:
@@ -189,11 +198,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def percentile(self, q: float) -> float:
         """The upper bound of the bucket holding the q-th percentile
@@ -201,11 +212,14 @@ class Histogram:
         NaN when the histogram is empty."""
         if not 0.0 <= q <= 100.0:
             raise ValueError(f"percentile {q!r} not in [0, 100]")
-        if self._count == 0:
+        with self._lock:
+            count = self._count
+            counts = list(self._counts)
+        if count == 0:
             return math.nan
-        rank = max(1, math.ceil(self._count * q / 100.0))
+        rank = max(1, math.ceil(count * q / 100.0))
         cum = 0
-        for j, c in enumerate(self._counts):
+        for j, c in enumerate(counts):
             cum += c
             if cum >= rank:
                 return (
@@ -214,14 +228,19 @@ class Histogram:
         return math.inf  # unreachable
 
     def snapshot(self) -> dict:
-        return {
-            "kind": self.kind,
-            "help": self.help,
-            "buckets": list(self.buckets),
-            "counts": list(self._counts),
-            "sum": self._sum,
-            "count": self._count,
-        }
+        # counts/sum/count must come from ONE critical section: a scrape
+        # racing observe() otherwise exports counts summing to count±1 —
+        # a torn histogram no strict re-parser can detect (the numbers
+        # are each individually plausible)
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "help": self.help,
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+            }
 
 
 class MetricsRegistry:

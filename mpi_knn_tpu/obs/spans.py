@@ -145,12 +145,17 @@ class FlightRecorder:
         }
         if attrs:
             rec["attrs"] = attrs
-        self._open_t0[sid] = time.perf_counter()
+        with self._lock:
+            # under the recorder lock (host-lint H1): begin/end run on
+            # every serving thread, and an unguarded dict write here
+            # races the pop in end() on another thread
+            self._open_t0[sid] = time.perf_counter()
         self._write(rec)
         return sid
 
     def end(self, sid: int, **attrs) -> None:
-        t0 = self._open_t0.pop(sid, None)
+        with self._lock:
+            t0 = self._open_t0.pop(sid, None)
         rec = {
             "ev": "E",
             "span": sid,
@@ -194,6 +199,11 @@ class FlightRecorder:
 # ---------------------------------------------------------------------------
 # process-level recorder (explicit install wins over the env var)
 
+# module lock for the recorder globals (host-lint H1): get_recorder runs
+# on every instrumented thread — pump, HTTP handlers, warm pool — and an
+# unguarded lazy construction here could open two FlightRecorder handles
+# onto one path (duplicated, interleaved generations)
+_reclock = threading.Lock()
 _recorder: FlightRecorder | None = None
 _env_recorder: FlightRecorder | None = None
 
@@ -202,9 +212,10 @@ def set_recorder(rec: FlightRecorder | None) -> None:
     """Install (or clear) the process recorder explicitly — the serve
     CLI's ``--flight-record`` path. Overrides ``TKNN_FLIGHT_RECORD``."""
     global _recorder
-    if _recorder is not None and _recorder is not rec:
-        _recorder.close()
-    _recorder = rec
+    with _reclock:
+        prev, _recorder = _recorder, rec
+    if prev is not None and prev is not rec:
+        prev.close()
 
 
 def get_recorder() -> FlightRecorder | None:
@@ -212,14 +223,15 @@ def get_recorder() -> FlightRecorder | None:
     to ``TKNN_FLIGHT_RECORD`` (cached per path — supervisors point each
     worker at a fresh file), else None."""
     global _env_recorder
-    if _recorder is not None:
-        return _recorder
-    path = os.environ.get(RECORDER_ENV)
-    if not path:
-        return None
-    if _env_recorder is None or _env_recorder.path != path:
-        _env_recorder = FlightRecorder(path)
-    return _env_recorder
+    with _reclock:
+        if _recorder is not None:
+            return _recorder
+        path = os.environ.get(RECORDER_ENV)
+        if not path:
+            return None
+        if _env_recorder is None or _env_recorder.path != path:
+            _env_recorder = FlightRecorder(path)
+        return _env_recorder
 
 
 def begin_span(name: str, cat: str = "", **attrs) -> int | None:

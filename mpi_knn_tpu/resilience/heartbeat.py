@@ -28,7 +28,8 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
+
+from mpi_knn_tpu.utils.atomicio import atomic_write_text
 
 HEARTBEAT_ENV = "TKNN_HEARTBEAT_FILE"
 
@@ -45,18 +46,10 @@ class HeartbeatWriter:
     def beat(self, label: str = "") -> int:
         self.seq += 1
         doc = {"seq": self.seq, "label": label, "pid": os.getpid()}
-        d = os.path.dirname(os.path.abspath(self.path)) or "."
-        fd, tmp = tempfile.mkstemp(prefix=".beat.", dir=d)
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, self.path)  # atomic: readers never see a torn file
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # atomic temp+replace (utils.atomicio — the shared H4 helper):
+        # the supervisor polls this file mid-overwrite, and must read
+        # the previous beat or this one, never a torn line
+        atomic_write_text(self.path, json.dumps(doc))
         return self.seq
 
 

@@ -63,6 +63,7 @@ import threading
 import warnings
 
 from mpi_knn_tpu.obs import metrics as obs_metrics
+from mpi_knn_tpu.utils.atomicio import atomic_write_bytes
 
 # bump when the entry layout (or anything about how executables are
 # rebuilt from entries) changes: old entries must MISS, not half-load
@@ -264,9 +265,10 @@ class AOTCache:
     # -- write side -------------------------------------------------------
 
     def store(self, key: str, compiled, meta: dict | None = None) -> bool:
-        """Serialize ``compiled`` under ``key`` via write-to-temp +
-        atomic ``os.replace`` (concurrent writers race benignly: the
-        last full entry wins, readers never see a torn file). Returns
+        """Serialize ``compiled`` under ``key`` via the shared atomic
+        temp + ``os.replace`` helper (``utils.atomicio``; concurrent
+        writers race benignly: the last full entry wins, readers never
+        see a torn file). Returns
         False — counted and warned, never raised — when the executable
         does not support serialization or the write fails: a broken
         cache must not take serving down with it."""
@@ -288,11 +290,7 @@ class AOTCache:
                 "out_tree": pickle.dumps(out_tree),
                 "meta": meta or {},
             }
-            tmp = self.dir / (
-                f".{key}.{os.getpid()}.{threading.get_ident()}.tmp"
-            )
-            tmp.write_bytes(pickle.dumps(doc))
-            os.replace(tmp, self.entry_path(key))
+            atomic_write_bytes(self.entry_path(key), pickle.dumps(doc))
         except Exception as e:  # noqa: BLE001 — storing is best-effort
             _count_error()
             warnings.warn(

@@ -1043,6 +1043,14 @@ class ServeSession:
         self._warm_lock = threading.Lock()
         self.warm_state: dict = {"total": 0, "ready": 0, "done": True}
         self.warm_report: dict | None = None
+        # window accumulators + current rung are read cross-thread (the
+        # front end's /healthz handler threads via stats_snapshot, the
+        # scheduler's shed/restore callbacks) while the dispatch pump
+        # mutates them at retire — one lock guards them all (host-lint
+        # H1 guard map: serve.engine.ServeSession). The in-flight deque
+        # and seq counter stay pump-confined: the session has exactly
+        # one dispatching caller by contract.
+        self._stats_lock = threading.Lock()
         self._inflight: collections.deque = collections.deque()
         self.latencies: list[float] = []
         self.queries_served = 0
@@ -1070,7 +1078,33 @@ class ServeSession:
     @property
     def rung(self) -> str:
         """The ladder rung new submissions dispatch under."""
-        return self.ladder[self._rung][0]
+        with self._stats_lock:
+            return self.ladder[self._rung][0]
+
+    def warm_snapshot(self) -> dict:
+        """A consistent copy of ``warm_state`` for cross-thread readers
+        (the /healthz handler, the front end's warming admission) —
+        ``dict(session.warm_state)`` outside the warm lock raced the
+        pool threads' per-cell updates (a dict being replaced AND
+        mutated while iterated)."""
+        with self._warm_lock:
+            return dict(self.warm_state)
+
+    def stats_snapshot(self) -> dict:
+        """The serving-posture counters read from other threads (the
+        front end's /healthz), in ONE critical section — reading the
+        raw attributes while the dispatch pump retires a batch tears
+        (e.g. ``sorted(tenant_stats)`` raises mid-rehash, queries_served
+        disagrees with batches_retired)."""
+        with self._stats_lock:
+            return {
+                "batches_retired": len(self.latencies),
+                "queries_served": self.queries_served,
+                "retries_total": self.retries_total,
+                "deadline_breaches": self.deadline_breaches,
+                "rung": self.ladder[self._rung][0],
+                "tenants": sorted(self.tenant_stats),
+            }
 
     def warm(self, sizes, parallel: int | None = None,
              progress=None) -> dict:
@@ -1178,7 +1212,7 @@ class ServeSession:
     def bucket_ready(self, rows: int) -> bool:
         """Whether a batch of exactly ``rows`` rows would dispatch on an
         already-built executable at the CURRENT ladder rung."""
-        _, cfg = self.ladder[self._rung]
+        _, cfg = self._current_rung()
         key = (bucket_rows(max(1, rows), cfg.query_bucket),
                _fingerprint_cfg(cfg))
         return key in self.index._cache
@@ -1192,7 +1226,7 @@ class ServeSession:
         cold bucket and compile inline on the dispatch pump (exactly the
         stall the 503 "warming" refusal exists to prevent). True iff
         every bucket in that span is built at the current rung."""
-        _, cfg = self.ladder[self._rung]
+        _, cfg = self._current_rung()
         fp = _fingerprint_cfg(cfg)
         b = bucket_rows(max(1, rows), cfg.query_bucket)
         top = bucket_rows(max(1, max(rows, max_rows)), cfg.query_bucket)
@@ -1222,20 +1256,30 @@ class ServeSession:
           double-counts a batch, it only decides which window's
           percentile the batch feeds.
         """
-        self.latencies = []
-        self.queries_served = 0
-        self.retries_total = 0
-        self.deadline_breaches = 0
-        self.tenant_stats = {}
-        if self.exchange is not None:
-            # the candidate-exchange story is part of the window: totals
-            # spanning a warm-up batch would overstate routed volume
-            self.exchange.update(
-                routed_total=0,
-                dropped_total=0,
-                exchange_bytes_total=0,
-                served_per_shard=[0] * self.exchange["shards"],
-            )
+        with self._stats_lock:
+            self.latencies = []
+            self.queries_served = 0
+            self.retries_total = 0
+            self.deadline_breaches = 0
+            self.tenant_stats = {}
+            if self.exchange is not None:
+                # the candidate-exchange story is part of the window:
+                # totals spanning a warm-up batch would overstate routed
+                # volume
+                self.exchange.update(
+                    routed_total=0,
+                    dropped_total=0,
+                    exchange_bytes_total=0,
+                    served_per_shard=[0] * self.exchange["shards"],
+                )
+
+    def _current_rung(self) -> tuple:
+        """(label, cfg) of the rung new work dispatches under — one
+        locked read of ``_rung`` (mutated by shed/restore, possibly from
+        the scheduler's overload callback while a handler thread asks
+        ``bucket_ready``)."""
+        with self._stats_lock:
+            return self.ladder[self._rung]
 
     def _check_sentinel(self, res: BatchResult) -> None:
         """NaN/all-inf sentinel on a retired batch's REAL rows. NaN in a
@@ -1292,7 +1336,8 @@ class ServeSession:
             self._consecutive_breaches = 0
             return
         res.deadline_breached = True
-        self.deadline_breaches += 1
+        with self._stats_lock:
+            self.deadline_breaches += 1
         self._consecutive_breaches += 1
         self._metrics.counter(
             "serve_deadline_breaches_total",
@@ -1314,22 +1359,25 @@ class ServeSession:
         ``degrade`` flight event, and the registry counter + rung gauge —
         a rung walk is never invisible. Returns the new rung's label, or
         None when already at the ladder floor (nothing shed)."""
-        if self._rung >= len(self.ladder) - 1:
-            return None
-        self._rung += 1
-        self._consecutive_breaches = 0
-        label = self.ladder[self._rung][0]
-        self.degradations.append({
-            "after_batch": after_batch if after_batch is not None
-            else max(0, self._seq - 1),
-            "rung": label,
-            "breaches": self.deadline_breaches,
-            "reason": reason,
-        })
+        with self._stats_lock:
+            if self._rung >= len(self.ladder) - 1:
+                return None
+            self._rung += 1
+            self._consecutive_breaches = 0
+            label = self.ladder[self._rung][0]
+            rung_idx = self._rung
+            breaches = self.deadline_breaches
+            ev = {
+                "after_batch": after_batch if after_batch is not None
+                else max(0, self._seq - 1),
+                "rung": label,
+                "breaches": breaches,
+                "reason": reason,
+            }
+            self.degradations.append(ev)
         obs_spans.event(
-            "degrade", cat="serve",
-            after_batch=self.degradations[-1]["after_batch"],
-            rung=label, breaches=self.deadline_breaches, reason=reason,
+            "degrade", cat="serve", after_batch=ev["after_batch"],
+            rung=label, breaches=breaches, reason=reason,
         )
         self._metrics.counter(
             "serve_degradations_total",
@@ -1338,7 +1386,7 @@ class ServeSession:
         self._metrics.gauge(
             "serve_ladder_rung",
             help="current degradation-ladder rung index (0 = full)",
-        ).set(self._rung)
+        ).set(rung_idx)
         return label
 
     def restore_rung(self, *, reason: str = "recovered") -> str | None:
@@ -1350,12 +1398,14 @@ class ServeSession:
         pre-compiles the whole ladder), so a restore can never cold-
         compile into recovering traffic. Returns the restored rung's
         label, or None when already serving the full rung."""
-        if self._rung == 0:
-            return None
-        self._rung -= 1
-        self._consecutive_breaches = 0
-        label = self.ladder[self._rung][0]
-        self.restorations.append({"rung": label, "reason": reason})
+        with self._stats_lock:
+            if self._rung == 0:
+                return None
+            self._rung -= 1
+            self._consecutive_breaches = 0
+            label = self.ladder[self._rung][0]
+            rung_idx = self._rung
+            self.restorations.append({"rung": label, "reason": reason})
         obs_spans.event("restore", cat="serve", rung=label, reason=reason)
         self._metrics.counter(
             "serve_restorations_total",
@@ -1364,15 +1414,16 @@ class ServeSession:
         self._metrics.gauge(
             "serve_ladder_rung",
             help="current degradation-ladder rung index (0 = full)",
-        ).set(self._rung)
+        ).set(rung_idx)
         return label
 
     def _retire(self) -> BatchResult:
         res, t0, sid = self._inflight.popleft()
         device_sync(res.dists_padded, res.ids_padded)
         res.latency_s = time.perf_counter() - t0
-        self.latencies.append(res.latency_s)
-        self.queries_served += res.rows
+        with self._stats_lock:
+            self.latencies.append(res.latency_s)
+            self.queries_served += res.rows
         self._note_latency(res)
         if self.policy is not None and self.policy.nan_sentinel:
             try:
@@ -1394,15 +1445,19 @@ class ServeSession:
             # raw parts would inflate batches and latency_sum per request
             for t, n in res.tenants:
                 tenant_rows[t] = tenant_rows.get(t, 0) + n
+            with self._stats_lock:
+                for t, n in tenant_rows.items():
+                    st = self.tenant_stats.setdefault(t, {
+                        "queries": 0, "batches": 0,
+                        "latency_sum_s": 0.0, "latency_max_s": 0.0,
+                    })
+                    st["queries"] += n
+                    st["batches"] += 1
+                    st["latency_sum_s"] += res.latency_s
+                    st["latency_max_s"] = max(
+                        st["latency_max_s"], res.latency_s
+                    )
             for t, n in tenant_rows.items():
-                st = self.tenant_stats.setdefault(t, {
-                    "queries": 0, "batches": 0,
-                    "latency_sum_s": 0.0, "latency_max_s": 0.0,
-                })
-                st["queries"] += n
-                st["batches"] += 1
-                st["latency_sum_s"] += res.latency_s
-                st["latency_max_s"] = max(st["latency_max_s"], res.latency_s)
                 self._metrics.counter(
                     "serve_tenant_queries_total",
                     help="query rows served per tenant (padding excluded)",
@@ -1424,24 +1479,26 @@ class ServeSession:
             )
             routed = int(per_shard[:, 0].sum())
             dropped = int(per_shard[:, 1].sum())
-            if self.exchange is not None:
-                self.exchange["routed_total"] += routed
-                self.exchange["dropped_total"] += dropped
-                self.exchange["exchange_bytes_total"] += (
-                    res.exchange_bytes or 0
-                )
-                for s, n in enumerate(per_shard[:, 2].tolist()):
-                    self.exchange["served_per_shard"][s] += int(n)
-            if tenant_rows and res.rows:
-                # tenant-attributable exchange: the routed volume is a
-                # batch-level fact (routes are per query TILE, tiles mix
-                # tenants), so the per-tenant share is rows-proportional
-                # — documented as an attribution, not a count
-                for t, n in tenant_rows.items():
-                    self.tenant_stats[t]["routed"] = (
-                        self.tenant_stats[t].get("routed", 0.0)
-                        + routed * n / res.rows
+            with self._stats_lock:
+                if self.exchange is not None:
+                    self.exchange["routed_total"] += routed
+                    self.exchange["dropped_total"] += dropped
+                    self.exchange["exchange_bytes_total"] += (
+                        res.exchange_bytes or 0
                     )
+                    for s, n in enumerate(per_shard[:, 2].tolist()):
+                        self.exchange["served_per_shard"][s] += int(n)
+                if tenant_rows and res.rows:
+                    # tenant-attributable exchange: the routed volume is
+                    # a batch-level fact (routes are per query TILE,
+                    # tiles mix tenants), so the per-tenant share is
+                    # rows-proportional — documented as an attribution,
+                    # not a count
+                    for t, n in tenant_rows.items():
+                        self.tenant_stats[t]["routed"] = (
+                            self.tenant_stats[t].get("routed", 0.0)
+                            + routed * n / res.rows
+                        )
             extra = {"routed": routed, "dropped": dropped}
             # the per-shard load event is the hang-attribution record: a
             # flight reader pairing an OPEN batch span with the LAST
@@ -1510,7 +1567,7 @@ class ServeSession:
                     f"batch has {int(queries.shape[0])}: refusing to "
                     "mis-attribute per-tenant stats"
                 )
-        label, cfg = self.ladder[self._rung]
+        label, cfg = self._current_rung()
         # the batch span opens BEFORE the dispatch attempt: a hang inside
         # the dispatch leaves an OPEN "batch" record in the flight file —
         # the kill diagnosis a supervisor banks (ISSUE 7). Sharded-
@@ -1544,7 +1601,8 @@ class ServeSession:
                 )
                 bucket, rows, d, i, stats, xbytes = out.value
                 retries, backoffs = out.attempts - 1, out.backoffs
-                self.retries_total += retries
+                with self._stats_lock:
+                    self.retries_total += retries
                 if retries:
                     obs_spans.event(
                         "retry", cat="retry", seq=self._seq,
@@ -1621,7 +1679,7 @@ class ServeSession:
         from mpi_knn_tpu.obs.attribution import attribute_trace
 
         batches = list(batches)
-        _, cfg = self.ladder[self._rung]
+        _, cfg = self._current_rung()
         for rows in sorted({int(q.shape[0]) for q in batches}):
             get_executable(
                 self.index, cfg, bucket_rows(rows, cfg.query_bucket)

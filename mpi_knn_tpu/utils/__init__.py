@@ -1,4 +1,29 @@
-from mpi_knn_tpu.utils.timing import PhaseTimer
-from mpi_knn_tpu.utils.report import RunReport
+"""Shared utilities. Lazy (PEP 562) exports, like the package root: the
+jax-free leaves (``atomicio``, ``logs``) are imported by the resilience
+supervisors and the heartbeat writer — processes that must stay light
+and must never touch a (possibly wedged) device transport — and an eager
+``from .timing import PhaseTimer`` here would drag jax into every one of
+them (and add seconds of import wall to a supervised child's first
+heartbeat)."""
 
-__all__ = ["PhaseTimer", "RunReport"]
+import importlib
+import typing
+
+_EXPORTS = {
+    "PhaseTimer": "mpi_knn_tpu.utils.timing",
+    "RunReport": "mpi_knn_tpu.utils.report",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)
+
+
+if typing.TYPE_CHECKING:  # pragma: no cover — static analysis only
+    from mpi_knn_tpu.utils.report import RunReport  # noqa: F401
+    from mpi_knn_tpu.utils.timing import PhaseTimer  # noqa: F401

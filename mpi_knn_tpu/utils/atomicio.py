@@ -1,0 +1,60 @@
+"""Atomic file publication — the ONE temp + ``os.replace`` helper every
+threaded (or supervised) writer of a shared artifact flows through.
+
+Why a single helper: the repo's cross-process rendezvous files are all
+read while they are written — the supervisor polls the heartbeat file
+mid-overwrite, the CI gate polls ``--ready-file`` while the server
+writes it, concurrent warm pools and bench children share one AOT cache
+directory. A bare ``open(path, "w")`` publishes a zero-length (then
+partially-written) file to every concurrent reader; ``os.replace`` of a
+fully-written temp file in the SAME directory publishes either the old
+content or the new, never a torn state. The host concurrency lint
+(``mpi_knn_tpu.analysis.host``, rule H4) enforces exactly this: a
+truncating write in a threaded module that does not flow through this
+helper (or carry its own ``os.replace`` in the same function) is a
+finding.
+
+The temp file lives next to the target (``os.replace`` must not cross
+filesystems) and carries pid + thread id in its name, so concurrent
+writers to one path race benignly: last full write wins.
+
+No jax import anywhere in this module (supervisors use it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def _tmp_path(path: str) -> str:
+    d, base = os.path.split(os.path.abspath(path))
+    return os.path.join(
+        d or ".",
+        f".{base}.{os.getpid()}.{threading.get_ident()}.tmp",
+    )
+
+
+def atomic_write_bytes(path: str | os.PathLike[str], data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically: temp file in the target's
+    directory, then ``os.replace``. Readers see the old file or the new
+    one, never a truncated or half-written state."""
+    path = os.fspath(path)
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(
+    path: str | os.PathLike[str], text: str, encoding: str = "utf-8"
+) -> None:
+    """:func:`atomic_write_bytes` for text content."""
+    atomic_write_bytes(path, text.encode(encoding))

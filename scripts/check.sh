@@ -38,13 +38,45 @@ else
 fi
 
 if [ "$FAST" = 0 ]; then
-    note "native sanitizer builds (asan + ubsan)"
+    note "native sanitizer builds (asan + ubsan + tsan)"
     if command -v "${CXX:-g++}" >/dev/null 2>&1; then
         make -C native asan ubsan || fail=1
+        # tsan is best-effort at BUILD time (older toolchains lack
+        # -fsanitize=thread); the threaded reader sweep in
+        # tests/test_sanitizers.py skip-guards the same way
+        make -C native tsan || echo "SKIP: toolchain lacks -fsanitize=thread"
     else
         echo "SKIP: no C++ toolchain (\$CXX/g++)"
     fi
 fi
+
+note "host concurrency lint (ISSUE 13: mpi-knn lint --host)"
+# the threaded host modules — frontend pump + HTTP handlers, serve
+# engine, aot cache, metrics registry, span recorder, worker supervisor
+# — against the enforced guard map: H1 lock discipline (every shared
+# mutable attribute declared AND every access site inside its lock),
+# H2 lock-order acyclicity, H3 thread confinement, H4 atomic publish
+# (bare open(...,"w") in a threaded module is a finding; writers go
+# through utils.atomicio). Zero findings required; the waiver count is
+# PINNED so intentional unguarded access cannot accrete silently, and
+# the lock-acquisition graph is asserted acyclic from the report.
+python -m mpi_knn_tpu lint --host -q --out artifacts/lint || fail=1
+python - <<'HOSTEOF' || fail=1
+import json
+doc = json.load(open("artifacts/lint/host_report.json"))
+s = doc["summary"]
+assert doc["ok"] is True, "host lint not ok"
+assert s["findings"] == 0, f"host findings: {s['findings']}"
+assert s["problems"] == 0, f"stale guard map: {doc['problems']}"
+assert s["lock_graph_acyclic"] is True, doc["lock_graph"]["cycles"]
+assert s["waivers"] == 7, (
+    f"waiver count changed ({s['waivers']} != 7): every new waiver "
+    "needs a rationale in analysis/host/guards.py AND this pin bumped"
+)
+print(f"host lint gate: {s['targets']} targets, "
+      f"{s['classes_checked']} classes, {s['lock_edges']} lock edges, "
+      f"{s['waivers']} waivers (pinned)")
+HOSTEOF
 
 note "static lint of every backend's compiled program (mpi-knn lint)"
 # the default sweep is the full backend × metric × dtype matrix PLUS the

@@ -106,6 +106,32 @@ def _asan_runtime_or_skip(so_name: str):
     return libasan
 
 
+def _tsan_runtime_or_skip(so_name: str):
+    """Build + locate the matching TSan runtime, or skip (toolchains
+    without -fsanitize=thread fail the make and skip there). Same
+    same-compiler-family rule as ASan: a gcc libtsan under a clang-built
+    .so aborts at interceptor init."""
+    import os
+    import subprocess
+
+    _build_sanitizer_lib_or_skip(so_name)
+    cxx = os.environ.get("CXX", "g++")
+    if "clang" in cxx:
+        locator = [cxx, "-print-file-name=libclang_rt.tsan-x86_64.so"]
+    else:
+        locator = [cxx.replace("g++", "gcc") if "g++" in cxx else cxx,
+                   "-print-file-name=libtsan.so"]
+    try:
+        libtsan = subprocess.run(
+            locator, capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip(f"cannot locate the TSan runtime via {locator[0]}")
+    if not os.path.isabs(libtsan):
+        pytest.skip(f"{locator[0]} has no TSan runtime")
+    return libtsan
+
+
 def _run_sanitized(code: str, **env_extra):
     import os
     import subprocess
@@ -248,6 +274,98 @@ def test_native_vecs_reader_ubsan_clean():
     )
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
     assert "VECS_OK 3" in r.stdout
+
+
+def _threaded_vecs_sweep_code(lib_path) -> str:
+    """ISSUE 13 TSan sweep: 8 threads hammer the PRODUCTION fvecs/bvecs
+    read loop concurrently over shared files through ONE dlopened
+    sanitizer lib. The readers are documented stateless — this is the
+    machine check: any hidden shared state (a static scratch buffer, an
+    unlocked errno-style flag) is a data race TSan aborts on."""
+    return f"""
+import ctypes, struct, threading
+import numpy as np
+from pathlib import Path
+import tempfile
+from mpi_knn_tpu.data.vecs import read_vecs_native
+lib = ctypes.CDLL({str(lib_path)!r})
+with tempfile.TemporaryDirectory() as td:
+    tmp = Path(td)
+    rng = np.random.default_rng(0)
+    def write(path, arr, comp):
+        with open(path, 'wb') as f:
+            for row in arr:
+                f.write(struct.pack('<i', len(row)))
+                f.write(np.asarray(row, dtype=comp).tobytes())
+    X = rng.standard_normal((64, 24)).astype(np.float32)
+    write(tmp / 'a.fvecs', X, np.float32)
+    write(tmp / 'b.bvecs', (np.abs(X) * 10 % 200), np.uint8)
+    (tmp / 'trunc.fvecs').write_bytes((tmp / 'a.fvecs').read_bytes()[:-5])
+    ok = [0] * 8
+    rejected = [0] * 8
+    def sweep(i):
+        for _ in range(25):
+            a = read_vecs_native(tmp / 'a.fvecs', lib=lib)
+            b = read_vecs_native(tmp / 'b.bvecs', lib=lib)
+            assert a.shape == (64, 24) and b.shape == (64, 24)
+            ok[i] += 2
+            try:
+                read_vecs_native(tmp / 'trunc.fvecs', lib=lib)
+            except ValueError:
+                rejected[i] += 1
+    threads = [threading.Thread(target=sweep, args=(i,)) for i in range(8)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    print('TSAN_OK', sum(ok), 'TSAN_REJECTED', sum(rejected))
+    assert sum(ok) == 8 * 50 and sum(rejected) == 8 * 25
+"""
+
+
+def test_native_vecs_reader_tsan_clean_under_threaded_sweep():
+    """The fvecs/bvecs reader, built with ThreadSanitizer, survives a
+    concurrent 8-thread sweep with zero race reports (halt_on_error
+    turns any report into a non-zero exit). Skip-guarded like the UBSan
+    sweep when the toolchain lacks -fsanitize=thread."""
+    libtsan = _tsan_runtime_or_skip("libtknn_vecsio_tsan.so")
+    code = _threaded_vecs_sweep_code(
+        _REPO / "native/build/libtknn_vecsio_tsan.so"
+    )
+    r = _run_sanitized(code, LD_PRELOAD=libtsan,
+                       TSAN_OPTIONS="halt_on_error=1,report_bugs=1")
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "TSAN_OK 400" in r.stdout
+    assert "WARNING: ThreadSanitizer" not in r.stderr
+
+
+def test_native_mat_reader_tsan_clean_under_threaded_sweep():
+    """The MAT v5 parser under the same treatment: 4 threads × the
+    genuine-MATLAB fixture sweep, concurrently, one shared lib."""
+    libtsan = _tsan_runtime_or_skip("libtknn_matio_tsan.so")
+    data_dir = _scipy_mat_dir_or_skip()
+    code = f"""
+import ctypes, glob, threading
+from mpi_knn_tpu.data.matfile import read_mat_native
+lib = ctypes.CDLL({str(_REPO / 'native/build/libtknn_matio_tsan.so')!r})
+files = sorted(glob.glob({data_dir!r} + '/*.mat'))[:40]
+totals = [0] * 4
+def sweep(i):
+    for f in files:
+        try:
+            read_mat_native(f, lib=lib)
+        except ValueError:
+            pass
+        totals[i] += 1
+threads = [threading.Thread(target=sweep, args=(i,)) for i in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+print('MAT_TSAN_OK', sum(totals))
+assert sum(totals) == 4 * len(files)
+"""
+    r = _run_sanitized(code, LD_PRELOAD=libtsan,
+                       TSAN_OPTIONS="halt_on_error=1,report_bugs=1")
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "MAT_TSAN_OK" in r.stdout
+    assert "WARNING: ThreadSanitizer" not in r.stderr
 
 
 def test_logs_prefix_and_levels(capsys):
