@@ -53,6 +53,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "formed by the production coalescer, which must "
                    "compile exactly an existing serve-grid bucket — no "
                    "new programs — with R1–R5 re-certified on it)")
+    p.add_argument("--mutate", action="append",
+                   choices=["upsert", "delete", "compact"],
+                   help="restrict to the live-mutation cells (ISSUE 14: "
+                   "the donated in-place upsert/delete/compact programs "
+                   "from serve.mutate.lower_mutation — R5's every-output-"
+                   "aliased + no-corpus-copy contract and R2-strict's "
+                   "touched-working-set budget); repeatable")
     p.add_argument("--quant", action="append", choices=list(LINT_QUANTS),
                    help="restrict to quantized cells: xfer-int8 (the "
                    "block-scaled int8 ring transfer — R3's quant/dequant "
@@ -143,6 +150,7 @@ def main(argv=None) -> int:
         and (not args.policy or t.policy in args.policy)
         and (not args.schedule or t.schedule in args.schedule)
         and (not args.quant or t.quant in args.quant)
+        and (not args.mutate or t.mutate in args.mutate)
         and (t.serve or not args.serve)
         and (t.frontend or not args.frontend)
     ]

@@ -64,6 +64,7 @@ class TargetResult:
             "serve": self.target.serve,
             "ladder": self.target.ladder,
             "frontend": self.target.frontend,
+            "mutate": self.target.mutate,
             "ok": self.ok,
             "skipped": self.skipped,
             "rules_run": self.rules_run,

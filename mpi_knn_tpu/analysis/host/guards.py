@@ -92,6 +92,7 @@ def default_targets() -> list[HostTarget]:
             ("frontend.cli", p("frontend/cli.py")),
         )),
         HostTarget("serve.engine", (("serve.engine", p("serve/engine.py")),)),
+        HostTarget("serve.mutate", (("serve.mutate", p("serve/mutate.py")),)),
         HostTarget(
             "serve.aotcache", (("serve.aotcache", p("serve/aotcache.py")),)
         ),
@@ -161,6 +162,10 @@ def default_guards() -> GuardMap:
             "degradations": "_stats_lock",
             "restorations": "_stats_lock",
             "_rung": "_stats_lock",
+            # live-mutation window accumulators (ISSUE 14): mutations may
+            # arrive on HTTP handler threads while the pump retires
+            "mutation_stats": "_stats_lock",
+            "_compactor": "_stats_lock",
         },
         confined={
             # single-dispatcher contract: the session has exactly one
@@ -176,6 +181,21 @@ def default_guards() -> GuardMap:
         },
     )
     g.classes["serve.engine._BucketExec"] = ClassGuard()
+
+    # -- serve.mutate (ISSUE 14) ------------------------------------------
+    # the background compaction worker: its history/deferral counters are
+    # read by /healthz-adjacent snapshots while the tknn-compact thread
+    # appends; the index/store state it mutates is serialized by the
+    # per-index mutation lock (engine.mutation_lock — index instances are
+    # plain data carriers, not scanned classes; the lock discipline there
+    # is enforced by construction: every mutation entry point and the
+    # dispatch path take the lock, tested in tests/test_mutation.py)
+    g.classes["serve.mutate.Compactor"] = ClassGuard(
+        guarded={
+            "_history": "_lock",
+            "_deferred": "_lock",
+        },
+    )
 
     # -- aot cache --------------------------------------------------------
     g.classes["serve.aotcache.AOTCache"] = ClassGuard()

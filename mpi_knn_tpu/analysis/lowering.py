@@ -86,6 +86,16 @@ class LintTarget:
     # new programs, only fills existing buckets (R1–R5 re-certify on
     # what it fills)
     frontend: bool = False
+    # mutate != "" (ISSUE 14): the cell lints a LIVE-MUTATION program —
+    # "upsert" / "delete" / "compact" — lowered through the production
+    # serve.mutate.lower_mutation (the exact object the mutation
+    # executable cache compiles). R5 certifies the donated in-place
+    # store update (every output aliased, no corpus-sized copy) and
+    # R2-strict budgets the TOUCHED working set (the mutation chunk, or
+    # the whole store for a compact — never more), with the in-place
+    # scatter/dynamic-update-slice forms exempted as buffer-forwarding
+    # plumbing (meta strict_exempt_ops)
+    mutate: str = ""
 
     @property
     def label(self) -> str:
@@ -102,6 +112,8 @@ class LintTarget:
             base = f"{base}/ladder-{self.ladder}"
         if self.frontend:
             base = f"{base}/frontend"
+        if self.mutate:
+            base = f"{base}/mutate-{self.mutate}"
         return base
 
 
@@ -201,6 +213,29 @@ def default_targets() -> list[LintTarget]:
         # lints) — with R5's donation and R1–R4 re-certified on what
         # coalesced dispatch actually compiles
         LintTarget("serial", "l2", "float32", serve=True, frontend=True),
+    ] + [
+        # the LIVE-MUTATION cells (ISSUE 14): the donated in-place
+        # upsert/delete/compact programs of the mutable layouts, lowered
+        # through the production serve.mutate.lower_mutation. R5's
+        # every-output-aliased contract and copy census run on exactly
+        # what sustained churn executes (an un-donated store or a
+        # corpus-sized copy is a finding — injected counterexamples in
+        # tests/test_hlo_lint.py fire through this same rule path), and
+        # R2-strict's budget is the TOUCHED working set: the mutation
+        # chunk for upsert/delete (a full-store gather — the headroom-
+        # overflow shape — is a finding), the store itself only for the
+        # compact rebuild
+        LintTarget("serial", "l2", "float32", mutate="upsert"),
+        LintTarget("serial", "l2", "float32", mutate="delete"),
+        LintTarget("ivf", "l2", "float32", mutate="upsert"),
+        LintTarget("ivf", "l2", "float32", mutate="delete"),
+        LintTarget("ivf", "l2", "float32", mutate="compact"),
+        # the sharded store mutates through the SAME donated scatters
+        # under GSPMD — the donation/no-copy contract must survive the
+        # partitioner (R4's exchange accounting does not apply: mutation
+        # has no candidate exchange, and the partitioner owns whatever
+        # plumbing it emits)
+        LintTarget("ivf-sharded", "l2", "float32", mutate="upsert"),
     ] + [
         # the QUANTIZED cells (ISSUE 9). Ring transfer at int8 — mixed
         # policy only (config.py refuses exact): R3 certifies the
@@ -854,6 +889,101 @@ def _lower_serve(target: LintTarget):
     return lowered, index.cfg, meta
 
 
+# one mutation chunk at lint scale: small, but several scatter rows per
+# bucket so the in-place update is structurally faithful
+LINT_MUTATE_CHUNK = 32
+
+
+def _lower_mutate(target: LintTarget):
+    """Lower one live-mutation cell through the PRODUCTION
+    ``serve.mutate.lower_mutation`` — the exact Lowered the mutation
+    executable cache compiles (the lower_bucket stance). Meta wires
+    R5's donation contract (donated params per kind + the copy-census
+    threshold) and R2-strict's touched-working-set budget, with the
+    in-place scatter forms registered as buffer-forwarding plumbing."""
+    from mpi_knn_tpu.serve import mutate as serve_mutate
+    from mpi_knn_tpu.ivf import mutate as ivf_mutate
+
+    kind = target.mutate
+    if target.metric != "l2" or target.dtype != "float32":
+        raise UnsupportedTarget(
+            "the mutation cells lint the l2/float32 layouts (the quant "
+            "and dtype axes ride the same programs)"
+        )
+    if target.backend == "serial":
+        if kind == "compact":
+            raise UnsupportedTarget(
+                "the serial layout has no compact program (tombstones "
+                "reclaim in place)"
+            )
+        from mpi_knn_tpu.serve import build_index
+
+        cfg = _base_cfg(target).replace(backend="serial")
+        index = build_index(np.zeros((LINT_M, LINT_D), np.float32), cfg)
+        donated = (serve_mutate.SERIAL_UPSERT_DONATED
+                   if kind == "upsert" else ivf_mutate.DELETE_DONATED)
+    elif target.backend == "ivf":
+        cfg = _ivf_cfg(target)
+        index = _ivf_lint_index(cfg)
+        cfg = index.compatible_cfg(cfg)
+        donated = {
+            "upsert": ivf_mutate.UPSERT_DONATED,
+            "delete": ivf_mutate.DELETE_DONATED,
+            "compact": ivf_mutate.COMPACT_DONATED,
+        }[kind]
+    elif target.backend == "ivf-sharded":
+        _require_sharded_mesh()
+        cfg = _sharded_cfg(target)
+        index = _ivf_sharded_lint_index(cfg)
+        cfg = index.compatible_cfg(cfg)
+        donated = {
+            "upsert": ivf_mutate.UPSERT_DONATED,
+            "delete": ivf_mutate.DELETE_DONATED,
+        }[kind]
+    else:
+        raise UnsupportedTarget(
+            f"the {target.backend!r} layout refuses live mutation "
+            "(serve.mutate raises — a registered restriction)"
+        )
+    bucket = (index.bucket_cap if kind == "compact"
+              else LINT_MUTATE_CHUNK)
+    lowered = serve_mutate.lower_mutation(index, cfg, bucket, kind)
+    if kind == "compact":
+        store = index.buckets
+        budget = int(store.shape[0]) * index.bucket_cap * LINT_D
+        q_tile, c_tile = index.bucket_cap, LINT_D
+    elif kind == "delete":
+        budget = bucket  # two small index vectors — nothing else
+        q_tile, c_tile = bucket, 1
+    else:
+        budget = bucket * LINT_D  # the chunk rows (+ the same-sized
+        # at-rest cast / norms intermediates, inside the slack)
+        q_tile, c_tile = bucket, LINT_D
+    meta = {
+        "q_tile": q_tile,
+        "c_tile": c_tile,
+        "acc_bytes": 4,
+        "mutate": kind,
+        # R5: the donated store params MUST alias every output, and the
+        # program must not copy the resident corpus
+        "donated_params": donated,
+        "resident_bytes": serve_resident_bytes(index),
+        # R2 STRICT: the touched working set replaces the largest-input
+        # floor — a mutation program materializing store-sized payload
+        # (the headroom-overflow full-store gather) is a finding
+        "budget_elems": budget,
+        # the in-place update forms forward the donated buffer rather
+        # than materialize new payload (XLA aliases them in place —
+        # exactly what R5 certifies); everything that COMPUTES bytes
+        # (gather, dot, broadcast, concatenate, copy) stays on the hook
+        "strict_exempt_ops": (
+            "scatter", "dynamic-update-slice", "fusion", "bitcast",
+            "reshape",
+        ),
+    }
+    return lowered, cfg, meta
+
+
 _LOWERERS = {
     "serial": _lower_serial,
     "ring": _lower_ring,
@@ -868,6 +998,9 @@ _LOWERERS = {
 def lower_target(target: LintTarget):
     """(texts_by_stage, cfg, meta) for one matrix cell, cached — the test
     matrix and the CLI share lowerings within a process."""
+    if target.mutate:
+        lowered, cfg, meta = _lower_mutate(target)
+        return hlo_texts(lowered), cfg, meta
     if target.serve:
         lowered, cfg, meta = _lower_serve(target)
         return hlo_texts(lowered), cfg, meta

@@ -394,7 +394,15 @@ class R2Memory(Rule):
                 R2_SLACK * tile_elems,
                 ctx.meta.get("extra_elems", 0),
             ) * acc_bytes
-        exempt = self.STRICT_EXEMPT if strict is not None else ("parameter",)
+        # "strict_exempt_ops": configuration-registered buffer-forwarding
+        # opcodes beyond the structural plumbing — today the mutation
+        # cells' in-place scatter/dynamic-update-slice forms, which XLA
+        # aliases onto the donated store (the aliasing itself is R5's
+        # claim; here they would read as store-sized materializations)
+        exempt = (
+            self.STRICT_EXEMPT + tuple(ctx.meta.get("strict_exempt_ops", ()))
+            if strict is not None else ("parameter",)
+        )
         # quantized stores additionally bound the GATHERS at the wire
         # width: the probe/exchange gathers must move code lanes (+ the
         # small scale/id/norm tables), never float-widened rows — an
@@ -1002,7 +1010,13 @@ class R5Donation(Rule):
     )
 
     def applies(self, ctx) -> bool:
-        return bool(getattr(ctx.target, "serve", False))
+        # serve batch programs AND the live-mutation programs (ISSUE 14:
+        # the donation contract extends to upsert/delete/compact — an
+        # un-donated store update would re-pay the corpus per chunk)
+        return bool(
+            getattr(ctx.target, "serve", False)
+            or getattr(ctx.target, "mutate", "")
+        )
 
     def check(self, ctx, stage, module) -> list[Finding]:
         out = []
@@ -1088,6 +1102,16 @@ class R4Collectives(Rule):
     )
 
     def applies(self, ctx) -> bool:
+        # sharded-store MUTATION programs are GSPMD-partitioned scatters:
+        # they have no candidate exchange to account (the partitioner
+        # owns whatever plumbing it emits), so the sharded-exchange
+        # checker has no claim there; single-device mutation cells keep
+        # the no-collectives check like every other single-device program
+        if (
+            getattr(ctx.target, "mutate", "")
+            and ctx.target.backend == "ivf-sharded"
+        ):
+            return False
         return True
 
     def _check_sharded_exchange(self, ctx, stage, module, found):
@@ -1370,7 +1394,12 @@ class R6IvfProbe(Rule):
     def applies(self, ctx) -> bool:
         # the sharded form keeps the same probe discipline: the routed
         # exchange only ever moves gathered buckets, so every batched
-        # candidate dot still carries a gather in its backward slice
+        # candidate dot still carries a gather in its backward slice.
+        # Mutation programs have no candidate dots at all (a scatter and
+        # at most the centroid-score assignment) — the ≥1-probe-dot
+        # vacuity guard would misfire there, so they are out of scope.
+        if getattr(ctx.target, "mutate", ""):
+            return False
         return getattr(ctx.target, "backend", None) in ("ivf", "ivf-sharded")
 
     def check(self, ctx, stage, module) -> list[Finding]:

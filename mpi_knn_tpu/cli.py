@@ -294,6 +294,13 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.frontend.cli import loadgen_main
 
         return loadgen_main(argv[1:])
+    if argv and argv[0] == "mutate":
+        # live-mutation subcommand (ISSUE 14): upsert/delete/compact a
+        # saved index artifact offline, or POST mutations to a running
+        # `mpi-knn serve` front end. Same routing pattern as query.
+        from mpi_knn_tpu.serve.mutate_cli import main as mutate_main
+
+        return mutate_main(argv[1:])
     if argv and argv[0] == "doctor":
         # preflight device-health subcommand: tiny jit + device_sync in a
         # heartbeat-supervised subprocess (mpi_knn_tpu.resilience), JSON

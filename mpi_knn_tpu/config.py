@@ -237,6 +237,33 @@ class KNNConfig:
     # per-shard strict budget prices) against drop risk under routing
     # skew.
     ivf_route_cap: Optional[int] = None
+    # --- live mutation knobs (mpi_knn_tpu.serve.mutate) ------------------
+    # bucket_headroom: fractional spare capacity built into every bucket
+    # (clustered stores: bucket_cap = pad(max_cluster · (1+headroom));
+    # serial tile stacks: extra padded rows beyond the corpus). Headroom
+    # is what buys STATIC-SHAPE mutation: upserts land in pre-allocated
+    # free slots via an in-place donated scatter instead of growing (and
+    # therefore recompiling) the store. The default is 0.0 — headroom is
+    # RENT (every padded slot rides the full fixed-shape FLOPs and
+    # gather bytes; 0.5 measured ≈0.6× dense serve throughput on the
+    # bench baseline), so a frozen corpus pays nothing and a mutable one
+    # opts in explicitly (0.25–0.5 recommended; deletes/updates-in-place
+    # need none, and a headroom-less index that overflows compacts-and-
+    # grows under the session rather than failing).
+    bucket_headroom: float = 0.0
+    # base row bucket of the mutation executables: upsert/delete chunks
+    # pad to the smallest mutation_bucket·2^j rows, so sustained churn at
+    # ragged sizes quantizes to a handful of (bucket, kind) executables
+    # in the same AOT cache as serve — zero steady-state compiles.
+    mutation_bucket: int = 256
+    # background re-cluster/compact triggers (serve.mutate.Compactor):
+    # fire when ANY bucket's fill fraction reaches compact_fill_threshold
+    # (headroom nearly exhausted — the next upsert burst would overflow)
+    # or when tombstoned slots reach compact_tombstone_fraction of the
+    # live rows (deletes have outpaced reuse; centroids drift from the
+    # live set). Host-side pacing only — never reaches a lowering.
+    compact_fill_threshold: float = 0.9
+    compact_tombstone_fraction: float = 0.3
     # donate the per-batch top-k scratch to the serving executable
     # (donate_argnums): XLA aliases the scratch buffers to the outputs
     # (machine-checked from the module's input_output_alias by lint rule
@@ -385,6 +412,24 @@ class KNNConfig:
                 raise ValueError(
                     f"ivf_route_cap must be >= 1, got {self.ivf_route_cap}"
                 )
+        if not self.bucket_headroom >= 0.0:
+            raise ValueError(
+                f"bucket_headroom must be >= 0, got {self.bucket_headroom}"
+            )
+        if self.mutation_bucket < 1:
+            raise ValueError(
+                f"mutation_bucket must be >= 1, got {self.mutation_bucket}"
+            )
+        if not 0.0 < self.compact_fill_threshold <= 1.0:
+            raise ValueError(
+                "compact_fill_threshold must be in (0, 1], got "
+                f"{self.compact_fill_threshold}"
+            )
+        if not self.compact_tombstone_fraction > 0.0:
+            raise ValueError(
+                "compact_tombstone_fraction must be > 0, got "
+                f"{self.compact_tombstone_fraction}"
+            )
         if self.topk_block < 1:
             raise ValueError(f"topk_block must be >= 1, got {self.topk_block}")
         if self.k < 1:

@@ -69,6 +69,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="base row bucket of the executable cache; batches "
                    "pad to bucket*2^j rows")
     d.add_argument("--dispatch-depth", type=int, default=2)
+    d.add_argument("--partitions", type=int, default=None,
+                   help="serve a CLUSTERED (IVF) index: train this many "
+                   "k-means partitions at startup (sublinear probing; "
+                   "enables the background compactor for live mutation)")
+    d.add_argument("--nprobe", type=int, default=None,
+                   help="partitions probed per query (None with "
+                   "--partitions = recall-targeted auto-tune)")
+    d.add_argument("--bucket-headroom", type=float, default=0.0,
+                   help="fractional spare capacity per bucket/tile for "
+                   "LIVE mutation (POST /upsert, /delete — ISSUE 14): "
+                   "pre-allocated free slots the donated in-place "
+                   "scatters fill without a recompile. 0.0 (default) = "
+                   "zero-rent frozen corpus; 0.25-0.5 for mutable ones "
+                   "(headroom rows ride the fixed-shape FLOPs)")
+    d.add_argument("--mutation-bucket", type=int, default=256,
+                   help="base row bucket of the mutation executables "
+                   "(chunks pad to mutation_bucket*2^j)")
+    d.add_argument("--compactor-interval-s", type=float, default=0.25,
+                   help="background compactor trigger-poll period for "
+                   "clustered indices; 0 disables the compactor")
+    d.add_argument("--compact-fill-threshold", type=float, default=0.9)
+    d.add_argument("--compact-tombstone-fraction", type=float,
+                   default=0.3)
     d.add_argument("--cache-dir", default=None, metavar="DIR",
                    help="persistent AOT executable cache "
                    "(serve/aotcache.py; also via TKNN_AOT_CACHE): a "
@@ -187,6 +210,12 @@ def serve_main(argv=None) -> int:
             num_devices=args.devices,
             query_bucket=args.bucket,
             dispatch_depth=args.dispatch_depth,
+            partitions=args.partitions,
+            nprobe=args.nprobe,
+            bucket_headroom=args.bucket_headroom,
+            mutation_bucket=args.mutation_bucket,
+            compact_fill_threshold=args.compact_fill_threshold,
+            compact_tombstone_fraction=args.compact_tombstone_fraction,
         )
         policy = SLOPolicy(
             max_batch_rows=args.max_batch_rows or args.bucket,
@@ -205,7 +234,15 @@ def serve_main(argv=None) -> int:
         return 2
     t0 = time.perf_counter()
     try:
-        index = build_index(X, cfg)
+        if args.partitions is not None:
+            # the clustered index serves through the same engine/front
+            # end (it duck-types CorpusIndex) — and is the layout the
+            # background compactor supervises
+            from mpi_knn_tpu.ivf import build_ivf_index
+
+            index = build_ivf_index(X, cfg)
+        else:
+            index = build_index(X, cfg)
         # a ResiliencePolicy (even the default) builds the degradation
         # ladder the queue-driven shed walks; without one the session
         # would have only its full rung
@@ -261,6 +298,18 @@ def serve_main(argv=None) -> int:
     threading.Thread(target=_report_warm, daemon=True,
                      name="warm-report").start()
 
+    # background compaction (ISSUE 14): clustered indices get the
+    # trigger-driven re-cluster/compact worker (heartbeat/flight-
+    # recorded, deferred while the session sheds load); the dense
+    # layouts reclaim tombstones in place and need none
+    compactor = None
+    if args.compactor_interval_s > 0 and index.backend in (
+        "ivf", "ivf-sharded"
+    ):
+        compactor = session.start_compactor(
+            interval_s=args.compactor_interval_s
+        )
+
     stop = threading.Event()
 
     def _sig(signum, frame):
@@ -272,6 +321,8 @@ def serve_main(argv=None) -> int:
         while not stop.wait(0.5):
             pass
     finally:
+        if compactor is not None:
+            compactor.stop()
         server.stop()
         frontend.stop()
         if args.metrics_out:

@@ -178,6 +178,46 @@ class FrontendScheduler:
             retry_after_s=round(retry_after_s, 6),
         )
 
+    def _check_tenant(self, tenant: str) -> Rejection | None:
+        """Tenant-id sanitization shared by the query and mutation
+        admission paths: a tenant id flows into metrics LABELS and
+        flight attrs, so a value the exposition cannot carry verbatim
+        must be refused HERE, at the edge — admitted-then-crash-at-
+        retire would take the dispatch pump (and every other tenant)
+        down with one hostile header."""
+        if (
+            not tenant or len(tenant) > 256
+            or any(c in tenant for c in ('"', "\\", "\n", "\r"))
+        ):
+            return self._reject(
+                "invalid", "bad-tenant",
+                "tenant id must be 1-256 chars with no quotes, "
+                "backslashes, or newlines",
+                0.0,
+            )
+        return None
+
+    def _take_token(self, tenant: str, now: float) -> Rejection | None:
+        """One deterministic token-bucket charge (reads and writes share
+        the per-tenant budget — a tenant cannot starve its own queries
+        by flooding upserts, or vice versa). None = admitted."""
+        pol = self.policy
+        if pol.max_tenant_qps is None:
+            return None
+        tokens, last = self._buckets.get(tenant, (float(pol.burst), now))
+        tokens = min(
+            float(pol.burst), tokens + (now - last) * pol.max_tenant_qps
+        )
+        if tokens < 1.0:
+            self._buckets[tenant] = [tokens, now]
+            return self._reject(
+                tenant, "rate",
+                f"tenant exceeds max_tenant_qps={pol.max_tenant_qps}",
+                (1.0 - tokens) / pol.max_tenant_qps,
+            )
+        self._buckets[tenant] = [tokens - 1.0, now]
+        return None
+
     def submit(self, tenant: str, queries, rows: int, now: float):
         """Admit one request or refuse it: returns a
         :class:`~mpi_knn_tpu.frontend.coalesce.FrontendRequest` (admitted
@@ -187,21 +227,9 @@ class FrontendScheduler:
         tenant = str(tenant)
         rows = int(rows)
         pol = self.policy
-        if (
-            not tenant or len(tenant) > 256
-            or any(c in tenant for c in ('"', "\\", "\n", "\r"))
-        ):
-            # a tenant id flows into metrics LABELS and flight attrs: a
-            # value the exposition cannot carry verbatim must be refused
-            # HERE, at the edge — admitted-then-crash-at-retire would
-            # take the dispatch pump (and every other tenant) down with
-            # one hostile header
-            return self._reject(
-                "invalid", "bad-tenant",
-                "tenant id must be 1-256 chars with no quotes, "
-                "backslashes, or newlines",
-                0.0,
-            )
+        rej = self._check_tenant(tenant)
+        if rej is not None:
+            return rej
         if rows < 1 or rows > pol.max_batch_rows:
             return self._reject(
                 tenant, "oversized-request",
@@ -217,19 +245,9 @@ class FrontendScheduler:
                 f"would exceed max_queue_rows={pol.max_queue_rows}",
                 pol.max_wait_s,
             )
-        if pol.max_tenant_qps is not None:
-            tokens, last = self._buckets.get(tenant, (float(pol.burst), now))
-            tokens = min(
-                float(pol.burst), tokens + (now - last) * pol.max_tenant_qps
-            )
-            if tokens < 1.0:
-                self._buckets[tenant] = [tokens, now]
-                return self._reject(
-                    tenant, "rate",
-                    f"tenant exceeds max_tenant_qps={pol.max_tenant_qps}",
-                    (1.0 - tokens) / pol.max_tenant_qps,
-                )
-            self._buckets[tenant] = [tokens - 1.0, now]
+        rej = self._take_token(tenant, now)
+        if rej is not None:
+            return rej
         req = self.coalescer.admit(tenant, queries, rows, now)
         self.admitted += 1
         self._metrics.counter(
@@ -238,6 +256,39 @@ class FrontendScheduler:
             labels={"tenant": tenant},
         ).inc()
         return req
+
+    def admit_mutation(self, tenant: str, rows: int, now: float):
+        """Admission control for a MUTATION request (upsert/delete —
+        ISSUE 14): same tenant validation, size ceiling, and per-tenant
+        token bucket as queries (reads and writes share one offered-rate
+        budget — a tenant cannot starve its own queries by flooding
+        upserts, or vice versa), but no coalescer: mutations dispatch
+        synchronously under the index's mutation lock. Returns None
+        (admitted) or a structured :class:`Rejection` — the 429
+        governance the HTTP layer translates onto the wire."""
+        tenant = str(tenant)
+        rows = int(rows)
+        pol = self.policy
+        rej = self._check_tenant(tenant)
+        if rej is not None:
+            return rej
+        if rows < 1 or rows > pol.max_batch_rows:
+            return self._reject(
+                tenant, "oversized-request",
+                f"mutation of {rows} rows is outside [1, "
+                f"max_batch_rows={pol.max_batch_rows}]; split it",
+                0.0,
+            )
+        rej = self._take_token(tenant, now)
+        if rej is not None:
+            return rej
+        self.admitted += 1
+        self._metrics.counter(
+            "frontend_mutations_total",
+            help="mutation requests admitted (upsert/delete)",
+            labels={"tenant": tenant},
+        ).inc()
+        return None
 
     # -- dispatch ---------------------------------------------------------
 

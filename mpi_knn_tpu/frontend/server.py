@@ -187,6 +187,16 @@ class Frontend:
                             np.zeros((n, dim), np.float32),
                             self.session.index, self.session.cfg,
                         )
+                # the mutation cells too (ISSUE 14): a cold upsert would
+                # otherwise compile while HOLDING the mutation lock —
+                # stalling batch dispatch exactly once, at the worst time
+                from mpi_knn_tpu.serve.mutate import (
+                    supports_mutation,
+                    warm_mutation,
+                )
+
+                if supports_mutation(self.session.index):
+                    warm_mutation(self.session.index, self.session.cfg)
             finally:
                 # a failed warm releases the gate anyway: the same
                 # failure will re-raise loudly on the dispatch path
@@ -262,6 +272,46 @@ class Frontend:
             self._work.notify()
             return ticket
 
+    def upsert(self, tenant: str, ids, rows):
+        """Admit + execute one tenant's upsert (ISSUE 14): 429-governed
+        through the scheduler's shared per-tenant budget, then
+        dispatched synchronously on this (handler) thread — the index's
+        mutation lock serializes it with the pump's batch dispatch, so
+        no ticket machinery is needed. Returns the mutation stats dict,
+        or a structured :class:`Rejection`."""
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        with self._lock:
+            if self._stop or self._crashed is not None:
+                return Rejection(
+                    tenant=str(tenant), reason="shutting-down",
+                    detail="front end is stopping", retry_after_s=0.0,
+                    status=503,
+                )
+            rej = self.scheduler.admit_mutation(
+                tenant, rows.shape[0], self._clock()
+            )
+        if rej is not None:
+            return rej
+        return self.session.upsert(ids, rows, tenant=str(tenant))
+
+    def delete(self, tenant: str, ids):
+        """Admit + execute one tenant's delete — the upsert path's
+        429 governance over the tombstone scatter."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            if self._stop or self._crashed is not None:
+                return Rejection(
+                    tenant=str(tenant), reason="shutting-down",
+                    detail="front end is stopping", retry_after_s=0.0,
+                    status=503,
+                )
+            rej = self.scheduler.admit_mutation(
+                tenant, max(1, ids.shape[0]), self._clock()
+            )
+        if rej is not None:
+            return rej
+        return self.session.delete(ids, tenant=str(tenant))
+
     def stats(self) -> dict:
         """The health/posture snapshot ``GET /healthz`` serves.
 
@@ -299,6 +349,9 @@ class Frontend:
                 "batches_retired": posture["batches_retired"],
                 "queries_served": posture["queries_served"],
                 "tenants": posture["tenants"],
+                # live-mutation posture (ISSUE 14): the session window's
+                # upsert/delete/compaction counts
+                "mutation": posture.get("mutation", {}),
                 # what a load generator needs to shape requests
                 "dim": ses.index.dim,
                 "k": ses.cfg.k,
@@ -451,11 +504,83 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                 )
             return q
 
+        def _reject(self, out: Rejection) -> None:
+            self.send_response(out.status)
+            body = (json.dumps({
+                "error": out.reason,
+                "detail": out.detail,
+                "tenant": out.tenant,
+                "retry_after_s": out.retry_after_s,
+            }) + "\n").encode()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After",
+                             str(max(0.0, out.retry_after_s)))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n <= 0:
+                raise ValueError("empty request body")
+            return json.loads(self.rfile.read(n))
+
+        def _do_mutation(self, tenant: str) -> None:
+            """POST /upsert {"ids": [...], "rows": [[...]]} and
+            POST /delete {"ids": [...]} — tenant-attributed (X-Tenant),
+            429-governed through the scheduler's shared budget,
+            dispatched synchronously (the mutation lock serializes with
+            batch dispatch). Headroom overflow on the serial layout
+            surfaces as 507 (no re-cluster pass to absorb it); clustered
+            layouts compact-and-retry inside the session."""
+            from mpi_knn_tpu.ivf.mutate import BucketOverflowError
+
+            try:
+                doc = self._read_json()
+                ids = doc["ids"]
+                if self.path == "/upsert":
+                    dim = frontend.session.index.dim
+                    rows = np.asarray(doc["rows"], dtype=np.float32)
+                    if rows.ndim != 2 or rows.shape[1] != dim:
+                        raise ValueError(
+                            f"rows shape {rows.shape} does not match "
+                            f"index dim {dim}"
+                        )
+                    if len(ids) != rows.shape[0]:
+                        raise ValueError(
+                            f"{len(ids)} ids but {rows.shape[0]} rows"
+                        )
+            except (ValueError, KeyError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                if self.path == "/upsert":
+                    out = frontend.upsert(tenant, ids, rows)
+                else:
+                    out = frontend.delete(tenant, ids)
+            except BucketOverflowError as e:
+                self._json(507, {"error": "headroom-exhausted",
+                                 "detail": str(e)})
+                return
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — serving error
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if isinstance(out, Rejection):
+                self._reject(out)
+                return
+            self._json(200, out)
+
         def do_POST(self):  # noqa: N802 — stdlib handler convention
+            tenant = self.headers.get(TENANT_HEADER, DEFAULT_TENANT)
+            if self.path in ("/upsert", "/delete"):
+                self._do_mutation(tenant)
+                return
             if self.path != "/query":
                 self._json(404, {"error": f"no such route {self.path}"})
                 return
-            tenant = self.headers.get(TENANT_HEADER, DEFAULT_TENANT)
             try:
                 q = self._read_queries()
             except (ValueError, KeyError, TypeError) as e:
@@ -463,19 +588,7 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                 return
             out = frontend.submit(tenant, q)
             if isinstance(out, Rejection):
-                self.send_response(out.status)
-                body = (json.dumps({
-                    "error": out.reason,
-                    "detail": out.detail,
-                    "tenant": out.tenant,
-                    "retry_after_s": out.retry_after_s,
-                }) + "\n").encode()
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Retry-After",
-                                 str(max(0.0, out.retry_after_s)))
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._reject(out)
                 return
             try:
                 dists, ids = out.result(timeout=request_timeout_s)

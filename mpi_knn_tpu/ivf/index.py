@@ -117,6 +117,15 @@ class IVFIndex:
         return self.cfg.dtype
 
     @property
+    def live_rows(self) -> int:
+        """Rows currently live (non-tombstoned). ``m`` stays the
+        BUILD-time row count — it is executable-fingerprint material;
+        the dynamic truth lives on the mutation freelist."""
+        from mpi_knn_tpu.ivf.mutate import freelist_of
+
+        return freelist_of(self).live
+
+    @property
     def nbytes_resident(self) -> int:
         """Bytes of resident corpus payload (the bucket store: code/row
         array plus the scale table of a quantized store)."""
@@ -151,6 +160,7 @@ class IVFIndex:
         frozen = (
             "backend", "metric", "dtype", "partitions", "kmeans_iters",
             "kmeans_init", "ivf_seed", "center", "exclude_zero", "zero_eps",
+            "bucket_headroom",
         )
         want = cfg if cfg.backend != "auto" else cfg.replace(backend="serial")
         bad = [
@@ -295,7 +305,16 @@ def build_ivf_index(
     assign = np.asarray(res.assignments)
     counts = np.asarray(res.counts)
     P = cfg.partitions
-    cap = pad_to_multiple(max(int(counts.max()), 1), 8)
+    # capacity headroom (ISSUE 14): spare slots per bucket are what buy
+    # STATIC-SHAPE upserts — the freelist hands them out and a donated
+    # scatter fills them in place, no recompile. The padding slots carry
+    # id −1 (mask_tile: +inf candidates, never answers), so headroom
+    # costs padded FLOPs/gather bytes, not correctness — set
+    # bucket_headroom=0.0 for a frozen corpus.
+    need = max(int(counts.max()), 1)
+    cap = pad_to_multiple(
+        max(1, int(np.ceil(need * (1.0 + cfg.bucket_headroom)))), 8
+    )
 
     buckets_np = np.zeros((P, cap, dim), dtype=np.float32)
     ids_np = np.full((P, cap), -1, dtype=np.int32)
@@ -458,6 +477,11 @@ def save_ivf_index(index, path: str) -> str:
         # pre-quantization artifacts, defaulted on load
         "store_dtype": index.cfg.dtype,
         "has_mu": index.mu is not None,
+        # live-mutation provenance (informational — the freelist itself
+        # is DERIVED from bucket_ids on load, so tombstones and headroom
+        # round-trip through the id plane; pre-mutation artifacts simply
+        # lack this key and derive full headroom from their padding)
+        "live_rows": int((np.asarray(index.bucket_ids) >= 0).sum()),
     }
     # write-to-temp + atomic rename: a re-save over a path another
     # process is serving from (or has mmapped mid-load) must never

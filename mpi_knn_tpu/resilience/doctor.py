@@ -104,7 +104,73 @@ def _probe_child(platform: str, cache_dir: str | None = None) -> int:
         doc = {**cache.stats(), **rt}
         print(json.dumps({"aot_cache": doc}), flush=True)
         maybe_beat("aot-cache-done")
+    # fourth stdout line (ISSUE 14): the live-mutation probe — a tiny
+    # throwaway clustered index takes an upsert/delete/query round trip
+    # TWICE; the second pass must compile NOTHING (the zero-steady-state
+    # contract of the mutation executables, machine-counted from the
+    # same jax.monitoring capture). Deleted ids must never come back.
+    maybe_beat("mutation-probe")
+    print(json.dumps({"mutation": _mutation_probe()}), flush=True)
+    maybe_beat("mutation-done")
     return 0
+
+
+def _mutation_probe() -> dict:
+    """The doctor's mutation round trip (runs inside the supervised
+    probe child, after jax import): throwaway 64-row clustered index,
+    upsert → query → delete → query, twice — pass 2's compile count is
+    the verdict's hard evidence that sustained churn compiles nothing."""
+    import numpy as np
+
+    from mpi_knn_tpu.config import KNNConfig
+    from mpi_knn_tpu.ivf import build_ivf_index
+    from mpi_knn_tpu.obs.metrics import watch_compiles
+    from mpi_knn_tpu.serve.engine import query_knn
+
+    rng = np.random.default_rng(0)
+    cents = rng.standard_normal((4, 8)).astype(np.float32) * 6
+    X = (cents[rng.integers(0, 4, 64)]
+         + rng.standard_normal((64, 8)) * 0.1).astype(np.float32)
+    index = build_ivf_index(X, KNNConfig(
+        k=3, partitions=4, nprobe=4, kmeans_iters=4, query_tile=8,
+        query_bucket=8, mutation_bucket=8, dispatch_depth=1,
+        bucket_headroom=0.5,
+    ))
+
+    def round_trip(base_id: int) -> dict:
+        ids = np.arange(base_id, base_id + 4)
+        rows = (cents[0] + rng.standard_normal((4, 8)) * 0.05
+                ).astype(np.float32)
+        up = _sm().upsert_rows(index, ids, rows)
+        got = query_knn(rows, index, index.cfg, k=3)
+        found = bool(set(ids.tolist()) & set(got.ids.ravel().tolist()))
+        _sm().delete_rows(index, ids)
+        got2 = query_knn(rows, index, index.cfg, k=3)
+        ghost = bool(set(ids.tolist()) & set(got2.ids.ravel().tolist()))
+        return {"upserted": up["upserted"], "found": found,
+                "ghost": ghost}
+
+    pass1 = round_trip(1000)
+    with watch_compiles() as counts:
+        pass2 = round_trip(2000)
+    compiles = len(counts)
+    ok = (
+        pass1["found"] and pass2["found"]
+        and not pass1["ghost"] and not pass2["ghost"]
+        and compiles == 0
+    )
+    return {
+        "ok": ok,
+        "pass1": pass1,
+        "pass2": pass2,
+        "second_pass_compiles": compiles,
+    }
+
+
+def _sm():
+    from mpi_knn_tpu.serve import mutate as serve_mutate
+
+    return serve_mutate
 
 
 def run_probe(
@@ -135,6 +201,7 @@ def run_probe(
     probe = None
     metrics = None
     aot_cache = None
+    mutation = None
     if res.ok:
         for line in res.stdout.splitlines():
             try:
@@ -147,11 +214,21 @@ def run_probe(
                 metrics = doc["metrics"]
             elif isinstance(doc, dict) and "aot_cache" in doc:
                 aot_cache = doc["aot_cache"]
+            elif isinstance(doc, dict) and "mutation" in doc:
+                mutation = doc["mutation"]
     return {
         # the AOT cache block (ISSUE 12): None when no cache dir is
         # configured — absent, not a fake-healthy zero row
         "aot_cache": aot_cache,
-        "ok": bool(res.ok and probe is not None),
+        # the live-mutation block (ISSUE 14): upsert/delete/query round
+        # trip on a throwaway index, with the SECOND pass's compile
+        # count asserted zero (sustained churn must compile nothing) —
+        # a failed mutation probe fails the verdict
+        "mutation": mutation,
+        "ok": bool(
+            res.ok and probe is not None
+            and (mutation is None or mutation.get("ok", False))
+        ),
         "status": res.status if probe is not None or not res.ok
         else "crashed",  # rc 0 but no probe line = a broken child
         "probe": probe,

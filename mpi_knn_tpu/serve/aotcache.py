@@ -141,23 +141,32 @@ def platform_facts() -> dict:
     }
 
 
-def fingerprint_facts(index, cfg, bucket: int) -> dict:
+def fingerprint_facts(index, cfg, bucket: int, kind: str = "serve") -> dict:
     """The full human-readable fingerprint document (the sha256 preimage,
     also stored in each entry's meta so ``mpi-knn doctor`` and a human
-    with ``pickle.load`` can see WHY an entry is what it is)."""
+    with ``pickle.load`` can see WHY an entry is what it is). ``kind``
+    distinguishes the mutation programs (upsert/delete/assign/compact —
+    ``serve.mutate``) from the serve batch program; the default "serve"
+    is OMITTED from the document so every pre-mutation entry's address
+    is unchanged."""
     from mpi_knn_tpu.serve.engine import _fingerprint_cfg
 
-    return {
+    doc = {
         "cfg": dataclasses.asdict(_fingerprint_cfg(cfg)),
         "bucket": int(bucket),
         "index": index_facts(index),
         "platform": platform_facts(),
     }
+    if kind != "serve":
+        doc["kind"] = kind
+    return doc
 
 
-def fingerprint(index, cfg, bucket: int) -> str:
-    """Content address of one (index, config, bucket) cell."""
-    doc = json.dumps(fingerprint_facts(index, cfg, bucket), sort_keys=True)
+def fingerprint(index, cfg, bucket: int, kind: str = "serve") -> str:
+    """Content address of one (index, config, bucket[, kind]) cell."""
+    doc = json.dumps(
+        fingerprint_facts(index, cfg, bucket, kind=kind), sort_keys=True
+    )
     return hashlib.sha256(doc.encode()).hexdigest()
 
 

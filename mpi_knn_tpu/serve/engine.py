@@ -509,8 +509,17 @@ def _fingerprint_cfg(cfg: KNNConfig) -> KNNConfig:
     that never reach ``lower_bucket`` (dispatch_depth paces the session;
     query_bucket only selects the bucket, which is a separate key
     component). Without this, changing the dispatch depth would recompile
-    a bit-identical executable for every warm bucket."""
-    return cfg.replace(dispatch_depth=1, query_bucket=1)
+    a bit-identical executable for every warm bucket. The live-mutation
+    pacing knobs are host-only the same way: ``mutation_bucket`` only
+    selects a mutation cell's bucket, the compact thresholds pace the
+    background compactor, and ``bucket_headroom`` is a BUILD-time shape
+    input whose effect the index facts already carry (``bucket_cap``) —
+    none of them reach ``lower_bucket``."""
+    return cfg.replace(
+        dispatch_depth=1, query_bucket=1, mutation_bucket=1,
+        bucket_headroom=0.0, compact_fill_threshold=1.0,
+        compact_tombstone_fraction=1.0,
+    )
 
 
 # per-(index, cell) compile locks so a parallel warm pool (and a live
@@ -527,6 +536,28 @@ def _key_lock(index, key) -> threading.Lock:
         if lk is None:
             lk = locks[key] = threading.Lock()
         return lk
+
+
+def mutation_lock(index) -> threading.Lock:
+    """The per-index mutation lock (ISSUE 14): every live mutation
+    (upsert/delete scatter, compact swap — ``serve.mutate``) and every
+    batch dispatch (``_run``) serialize on it, so a query batch always
+    runs against a CONSISTENT store — wholly before or wholly after any
+    mutation, never an in-between (the donated in-place scatters would
+    otherwise race the dispatch reading ``_resident_args``). Held only
+    for the O(chunk) dispatch / O(1) swap, never across device waits.
+    The lookup is lock-free after first creation (this sits on EVERY
+    batch dispatch — funneling all sessions through the global mutex
+    per batch would add a cross-index serialization point); the dict
+    read is atomic under the GIL and the mutex only arbitrates the
+    one-time creation."""
+    lk = index.__dict__.get("_mutation_lock")
+    if lk is None:
+        with _KEYLOCK_MUTEX:
+            lk = index.__dict__.setdefault(
+                "_mutation_lock", threading.Lock()
+            )
+    return lk
 
 
 def get_executable(
@@ -778,7 +809,15 @@ def _run(index: CorpusIndex, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
     """Issue one padded batch on the compiled executable; returns padded
     ((q_pad, k) dists, ids, exchange_stats-or-None) device results
     (async — not synchronized here). The stats slot is populated only by
-    the sharded-clustered backend (its per-shard (N_STATS·S,) vector)."""
+    the sharded-clustered backend (its per-shard (N_STATS·S,) vector).
+    Dispatch serializes with live mutation on the per-index mutation
+    lock — the resident args are read and the batch enqueued as one
+    atomic step w.r.t. any in-place store update."""
+    with mutation_lock(index):
+        return _run_locked(index, cfg, exec_, q2d, qids)
+
+
+def _run_locked(index, cfg: KNNConfig, exec_: _BucketExec, q2d, qids):
     acc = _acc_dtype(cfg)
     if exec_.backend == "serial":
         qt = exec_.q_pad // exec_.q_tile
@@ -1062,6 +1101,14 @@ class ServeSession:
         # ``tenants`` composition): tenant -> {queries, batches,
         # latency_sum_s, latency_max_s[, routed]}
         self.tenant_stats: dict[str, dict] = {}
+        # live-mutation window accumulators (ISSUE 14): rows upserted/
+        # tombstoned through this session + compaction passes — guarded
+        # by _stats_lock like every other window stat; the index-level
+        # occupancy truth lives on the freelist (serve.mutate)
+        self.mutation_stats: dict[str, int] = {
+            "upserts": 0, "deletes": 0, "calls": 0, "compactions": 0,
+        }
+        self._compactor = None
         # sharded-clustered sessions accumulate the candidate-exchange
         # story (routed/dropped totals, static exchange bytes, per-shard
         # served-request load) for the CLI report; None elsewhere
@@ -1104,6 +1151,7 @@ class ServeSession:
                 "deadline_breaches": self.deadline_breaches,
                 "rung": self.ladder[self._rung][0],
                 "tenants": sorted(self.tenant_stats),
+                "mutation": dict(self.mutation_stats),
             }
 
     def warm(self, sizes, parallel: int | None = None,
@@ -1262,6 +1310,9 @@ class ServeSession:
             self.retries_total = 0
             self.deadline_breaches = 0
             self.tenant_stats = {}
+            self.mutation_stats = {
+                "upserts": 0, "deletes": 0, "calls": 0, "compactions": 0,
+            }
             if self.exchange is not None:
                 # the candidate-exchange story is part of the window:
                 # totals spanning a warm-up batch would overstate routed
@@ -1416,6 +1467,101 @@ class ServeSession:
             help="current degradation-ladder rung index (0 = full)",
         ).set(rung_idx)
         return label
+
+    # -- live mutation (ISSUE 14) -----------------------------------------
+    # Thin session-facing wrappers over serve.mutate: the index mutates
+    # under the per-index mutation lock (serialized with this session's
+    # dispatch), the session's window accumulators take the tenant-
+    # attributed story under _stats_lock. Mutations interleave freely
+    # with submit()/stream() from other threads — that is the point.
+
+    def upsert(self, ids, rows, tenant: str | None = None) -> dict:
+        """Upsert rows into the live index (static shapes, donated
+        in-place scatter — zero compiles at a warm mutation bucket).
+        Returns the mutation stats. A clustered index that overflows its
+        headroom compacts synchronously ONCE and retries (the background
+        compactor normally fires on the fill threshold first, so this is
+        the backstop for a burst that outruns it); the serial layout has
+        no re-cluster pass, so its overflow propagates. Raises
+        :class:`~mpi_knn_tpu.ivf.mutate.BucketOverflowError` when even a
+        compacted store cannot absorb the rows."""
+        from mpi_knn_tpu.ivf.mutate import BucketOverflowError
+        from mpi_knn_tpu.serve import mutate as serve_mutate
+
+        try:
+            stats = serve_mutate.upsert_rows(self.index, ids, rows, self.cfg)
+        except BucketOverflowError:
+            if self.index.backend == "serial":
+                raise
+            self.compact(reason="overflow")
+            try:
+                stats = serve_mutate.upsert_rows(
+                    self.index, ids, rows, self.cfg
+                )
+            except BucketOverflowError:
+                # a burst aimed at one cluster can outsize any balanced
+                # cap — grow it so the chunk is GUARANTEED to fit (the
+                # documented recompile path), rather than failing an
+                # admitted write
+                serve_mutate.compact_index(
+                    self.index, self.cfg, reason="overflow-grow",
+                    min_cap=self.index.bucket_cap + int(
+                        np.shape(rows)[0]
+                    ),
+                )
+                stats = serve_mutate.upsert_rows(
+                    self.index, ids, rows, self.cfg
+                )
+        self._note_mutation("upserts", stats.get("upserted", 0), tenant)
+        return stats
+
+    def delete(self, ids, tenant: str | None = None) -> dict:
+        """Tombstone ids in the live index (they are never returned
+        again; slots reclaim via the freelist). Idempotent for unknown
+        ids. Returns the mutation stats."""
+        from mpi_knn_tpu.serve import mutate as serve_mutate
+
+        stats = serve_mutate.delete_rows(self.index, ids, self.cfg)
+        self._note_mutation("deletes", stats.get("deleted", 0), tenant)
+        return stats
+
+    def compact(self, reason: str = "manual", retrain: bool = True) -> dict:
+        """Re-cluster/compact the live index now (the background
+        ``Compactor`` calls this on trigger): store rebuilt by one
+        donated scatter and swapped between batches under the mutation
+        lock."""
+        from mpi_knn_tpu.serve import mutate as serve_mutate
+
+        stats = serve_mutate.compact_index(
+            self.index, self.cfg, retrain=retrain, reason=reason
+        )
+        with self._stats_lock:
+            self.mutation_stats["compactions"] += 1
+        return stats
+
+    def start_compactor(self, interval_s: float = 0.25,
+                        retrain: bool = True):
+        """Start (and return) the background compaction worker for this
+        session — trigger-driven, heartbeat/flight-recorded, deferred
+        while the session is shedding load."""
+        from mpi_knn_tpu.serve.mutate import Compactor
+
+        compactor = Compactor(self, interval_s=interval_s, retrain=retrain)
+        with self._stats_lock:
+            self._compactor = compactor
+        return compactor.start()
+
+    def _note_mutation(self, kind: str, n: int,
+                       tenant: str | None) -> None:
+        with self._stats_lock:
+            self.mutation_stats[kind] += n
+            self.mutation_stats["calls"] += 1
+        if tenant is not None:
+            self._metrics.counter(
+                f"serve_tenant_{kind}_total",
+                help=f"rows {kind[:-1]}ed per tenant",
+                labels={"tenant": str(tenant)},
+            ).inc(n)
 
     def _retire(self) -> BatchResult:
         res, t0, sid = self._inflight.popleft()

@@ -87,6 +87,20 @@ class CorpusIndex:
         )
         return 0 if arr is None else arr.size * arr.dtype.itemsize
 
+    @property
+    def live_rows(self) -> int:
+        """Rows currently live (non-tombstoned) in a mutable (serial)
+        layout — from the mutation freelist; ``m`` stays the build-time
+        count (executable-fingerprint material)."""
+        from mpi_knn_tpu.ivf.mutate import freelist_of
+
+        if self.tiles is None:
+            raise ValueError(
+                f"the {self.backend!r} layout does not track liveness "
+                "(only the serial tile stack is mutable)"
+            )
+        return freelist_of(self).live
+
     def compatible_cfg(self, cfg: KNNConfig) -> KNNConfig:
         """Validate a per-query config against the build-time layout.
 
@@ -241,7 +255,14 @@ def _build_index_resident(corpus, cfg, mesh, backend, m, dim) -> CorpusIndex:
         min(cfg.corpus_tile, pad_to_multiple(m, 128)),
         cfg.max_tile_elems,
     )
-    c_pad = pad_to_multiple(m, c_tile)
+    # capacity headroom (ISSUE 14): extra id −1 rows beyond the corpus
+    # are the serial layout's upsert capacity — the mutation freelist
+    # fills them by donated in-place scatter with no shape change. They
+    # cost padded FLOPs per batch (masked, never answers); build with
+    # bucket_headroom=0.0 for a frozen corpus.
+    c_pad = pad_to_multiple(
+        max(m, int(np.ceil(m * (1.0 + cfg.bucket_headroom)))), c_tile
+    )
     tiles = pad_rows_any(corpus, c_pad, dtype=dtype).reshape(-1, c_tile, dim)
     tile_ids = jnp.asarray(make_global_ids(m, c_pad).reshape(-1, c_tile))
     # same norm construction as knn_chunk_update (zeros for cosine, where

@@ -54,6 +54,15 @@ Two families, one JSON artifact:
   sublinear speedup and the recall it buys are one artifact, so a probe
   count can never look fast without showing what it paid.
 
+- ``ivf_mutation``: the LIVE-MUTATION path (ISSUE 14) — steady-state
+  upsert and delete rows/s through the warm mutation executables
+  (freelist plan + donated in-place scatter), query p99 DURING sustained
+  background churn next to the quiesced p99 on the same session (the 2×
+  acceptance bound), one compact-pass wall time, and the comparison row
+  the tentpole is measured against: rebuild-per-batch (full k-means
+  retrain + build per mutation batch — the pre-PR "mutation"), in rows/s
+  over the same batch so the ≥10× bar reads directly off the artifact.
+
 CPU numbers say nothing absolute about the TPU — what they pin is the
 RELATIVE trajectory per op across PRs, on the platform CI always has
 (the same rationale as ring_scaling_cpu.py). On a real chip the same
@@ -616,6 +625,144 @@ def main(argv=None) -> int:
               f"median {row['median_s']}s  {row['queries_per_s']} q/s  "
               f"recall@{k} {row['recall_at_k']}  "
               f"{row['at_rest_bytes']} B", flush=True)
+
+    # -- LIVE MUTATION (ISSUE 14): steady-state churn vs rebuild ----------
+    # The write path's trajectory rows: upsert/delete rows/s at steady
+    # state (warm mutation executables, freelist reuse), query p99 DURING
+    # sustained churn next to the quiesced p99 on the same session (the
+    # 2× acceptance bound), one compact-pass wall time, and the
+    # comparison row the tentpole is measured against — rebuild-per-batch
+    # (a full k-means retrain + build_ivf_index per mutation batch, the
+    # only way to "mutate" before this PR). Same SIFT-shaped corpus.
+    from mpi_knn_tpu.serve import mutate as serve_mutate
+
+    mcfg = KNNConfig(
+        k=k, partitions=P, nprobe=at_rest_nprobe,
+        query_tile=min(1024, q), query_bucket=128, mutation_bucket=128,
+        bucket_headroom=0.5,  # the mutable configuration pays its rent
+        # here, next to the zero-headroom ivf_query rows — both visible
+    )
+    midx = build_ivf_index(Xi, mcfg)
+    msession = ServeSession(midx)
+    mbucket = 128
+    msession.warm([mbucket])
+    serve_mutate.warm_mutation(midx, msession.cfg, sizes=[mbucket])
+    B = 128
+    next_id = [10_000_000]
+
+    def churn_cycle(timed: str | None):
+        """One upsert+delete cycle of B rows (occupancy-neutral);
+        returns the wall seconds of the `timed` half."""
+        ids = np.arange(next_id[0], next_id[0] + B, dtype=np.int64)
+        next_id[0] += B
+        rows_b = Xi[(int(ids[0]) // B * B) % max(1, c - B):][:B]
+        t0 = time.perf_counter()
+        msession.upsert(ids, rows_b)
+        t_up = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        msession.delete(ids)
+        t_del = time.perf_counter() - t0
+        return t_up if timed == "upsert" else t_del
+
+    churn_cycle(None)  # warm the eager helpers outside the timed region
+    cycles = max(reps, 4)
+    for half in ("upsert", "delete"):
+        times = [churn_cycle(half) for _ in range(cycles)]
+        row = {
+            "op": "ivf_mutation",
+            "variant": f"{half}-steady-b{B}",
+            "median_s": round(statistics.median(times), 6),
+            "min_s": round(min(times), 6),
+            "reps_s": [round(t, 6) for t in times],
+            "rows_per_s": round(B / statistics.median(times), 1),
+        }
+        results.append(row)
+        print(f"{'ivf_mutation':16s} {row['variant']:20s} "
+              f"median {row['median_s']}s  {row['rows_per_s']} rows/s",
+              flush=True)
+
+    def serve_p99(label, churn: bool):
+        """p99 of one serving pass over the standard batches, with an
+        optional background churn thread interleaving upsert/delete
+        chunks through the same mutation lock the dispatch takes."""
+        import threading as _threading
+
+        batches = [Xi[(i * mbucket) % max(1, c - mbucket):][:mbucket]
+                   for i in range(max(4 * reps, 16))]
+        msession.submit(batches[0])
+        msession.drain()
+        msession.reset_stats()
+        stop = _threading.Event()
+
+        def _churn():
+            while not stop.is_set():
+                churn_cycle(None)
+
+        t = None
+        if churn:
+            t = _threading.Thread(target=_churn, daemon=True)
+            t.start()
+        t0 = time.perf_counter()
+        for b in batches:
+            msession.submit(b)
+        msession.drain()
+        wall = time.perf_counter() - t0
+        if t is not None:
+            stop.set()
+            t.join(30)
+        lats = sorted(msession.latencies)
+        row = {
+            "op": "ivf_mutation",
+            "variant": label,
+            "median_s": round(statistics.median(lats), 6),
+            "min_s": round(min(lats), 6),
+            "reps_s": [round(x, 6) for x in lats],
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "queries_per_s": round(msession.queries_served / wall, 1),
+        }
+        results.append(row)
+        print(f"{'ivf_mutation':16s} {row['variant']:20s} "
+              f"p99 {row['p99_ms']}ms  {row['queries_per_s']} q/s",
+              flush=True)
+        return row
+
+    quiesced = serve_p99("query-quiesced", churn=False)
+    churned = serve_p99("query-under-churn", churn=True)
+    print(f"{'ivf_mutation':16s} p99 churn/quiesced ratio "
+          f"{churned['p99_ms'] / max(1e-9, quiesced['p99_ms']):.2f}",
+          flush=True)
+
+    t0 = time.perf_counter()
+    msession.compact(reason="bench")
+    compact_wall = time.perf_counter() - t0
+    results.append({
+        "op": "ivf_mutation",
+        "variant": "compact",
+        "median_s": round(compact_wall, 6),
+        "min_s": round(compact_wall, 6),
+        "reps_s": [round(compact_wall, 6)],
+    })
+    print(f"{'ivf_mutation':16s} {'compact':20s} "
+          f"wall {compact_wall:.3f}s", flush=True)
+
+    # the comparison row: absorbing a B-row batch by REBUILDING the
+    # index (retrain + rebucket — the pre-PR "mutation"), denominated in
+    # rows/s over the same B so the tentpole's ≥10× bar reads directly
+    t0 = time.perf_counter()
+    build_ivf_index(Xi, mcfg)
+    rebuild_wall = time.perf_counter() - t0
+    results.append({
+        "op": "ivf_mutation",
+        "variant": f"rebuild-per-batch-b{B}",
+        "median_s": round(rebuild_wall, 6),
+        "min_s": round(rebuild_wall, 6),
+        "reps_s": [round(rebuild_wall, 6)],
+        "rows_per_s": round(B / rebuild_wall, 1),
+    })
+    print(f"{'ivf_mutation':16s} {'rebuild-per-batch':20s} "
+          f"wall {rebuild_wall:.3f}s  {B / rebuild_wall:.1f} rows/s",
+          flush=True)
 
     # -- SHARDED clustered path: routed candidate exchange over the mesh --
     # The same trained index distributed over 2- and 4-device ring meshes

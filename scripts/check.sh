@@ -247,6 +247,115 @@ note "cold-start gate (ISSUE 12: persistent AOT executable cache)"
 # cache, see analysis/README.md.)
 timeout -k 10 420 python scripts/check_cold_start.py || fail=1
 
+note "live-mutation gate (ISSUE 14: serve + HTTP upsert/delete/query)"
+# production `mpi-knn serve` over a CLUSTERED index with headroom and an
+# aggressive compaction trigger, driven end to end over HTTP: upserts,
+# deletes and queries interleave; /metrics is scraped twice around a
+# second churn round and must show ZERO mutation-path compiles between
+# scrapes (the warm steady state) with monotone upsert/delete counters;
+# the background compactor must fire on the tombstone threshold
+# (compactions_total >= 1); then SIGTERM lands while the compactor is
+# armed and the flight record must still validate (an open compact span
+# is a diagnosis, not corruption). The donation/aliasing CONTRACT on the
+# mutation programs is the lint matrix above (mutate-* cells); the
+# correctness matrix is tier-1 (tests/test_mutation.py).
+MUT_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP" "$FE_TMP" "$MUT_TMP"' EXIT
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu serve \
+    --data synthetic:2048x32c8 --k 10 --partitions 16 --nprobe 4 \
+    --bucket 128 --bucket-headroom 0.5 --mutation-bucket 64 \
+    --compact-tombstone-fraction 0.05 --compactor-interval-s 0.1 \
+    --port 0 --ready-file "$MUT_TMP/ready" \
+    --flight-record "$MUT_TMP/flight.jsonl" \
+    --metrics-out "$MUT_TMP/metrics.json" -q &
+MUT_PID=$!
+mut_ok=0
+for _ in $(seq 1 120); do
+    [ -s "$MUT_TMP/ready" ] && { mut_ok=1; break; }
+    kill -0 "$MUT_PID" 2>/dev/null || break
+    sleep 1
+done
+if [ "$mut_ok" = 1 ]; then
+    MUT_URL="$(cat "$MUT_TMP/ready")"
+    timeout -k 10 180 python - "$MUT_URL" <<'PYEOF' || fail=1
+import json, sys, time, urllib.request
+from mpi_knn_tpu.obs.metrics import parse_prometheus
+
+url = sys.argv[1]
+
+def post(path, doc):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", "X-Tenant": "ci"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+def scrape():
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        return parse_prometheus(r.read().decode())
+
+import numpy as np
+rng = np.random.default_rng(0)
+rows = lambda n: rng.standard_normal((n, 32)).astype(float).tolist()
+
+# wait for warming to finish so the steady-state claim is honest
+for _ in range(120):
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        if json.loads(r.read().decode())["ready"]:
+            break
+    time.sleep(0.5)
+# round 1: warm the mutation cells + interleave a query
+post("/upsert", {"ids": list(range(900000, 900064)), "rows": rows(64)})
+post("/query", {"queries": rows(16)})
+post("/delete", {"ids": list(range(900000, 900064))})
+m1 = scrape()
+# round 2 (the STEADY STATE): more churn at ragged sizes + queries
+for i, n in enumerate((7, 33, 64, 12)):
+    base = 910000 + i * 100
+    post("/upsert", {"ids": list(range(base, base + n)), "rows": rows(n)})
+    post("/query", {"queries": rows(5)})
+    post("/delete", {"ids": list(range(base, base + n))})
+m2 = scrape()
+compiled = "mutation_executables_compiled_total"
+assert m2.get(compiled, 0) == m1.get(compiled, 0), (
+    f"mutation path compiled in steady state: {m1.get(compiled)} -> "
+    f"{m2.get(compiled)}")
+assert m2["mutation_upserts_total"] > m1["mutation_upserts_total"], \
+    "upsert counter not monotone"
+assert m2["mutation_deletes_total"] > m1["mutation_deletes_total"], \
+    "delete counter not monotone"
+assert m2["index_tombstone_fraction"] >= 0, "tombstone gauge missing"
+# a deletes-only round (no upserts to reuse the slots): tombstones cross
+# the 5% trigger and the background compactor must fire (monotone
+# compactions counter). Chunked under max_batch_rows — an oversized
+# mutation is a structured 429 by design.
+post("/delete", {"ids": list(range(0, 128))})
+post("/delete", {"ids": list(range(128, 256))})
+deadline = time.time() + 60
+while time.time() < deadline:
+    m3 = scrape()
+    if m3.get("compactions_total", 0) >= 1:
+        break
+    time.sleep(0.5)
+assert m3.get("compactions_total", 0) >= 1, "compactor never fired"
+assert m3["mutation_upserts_total"] >= m2["mutation_upserts_total"]
+print(f"mutation gate: {int(m3['mutation_upserts_total'])} upserts, "
+      f"{int(m3['mutation_deletes_total'])} deletes, "
+      f"{int(m3['compactions_total'])} compaction(s), "
+      f"0 steady-state mutation compiles")
+PYEOF
+    kill -TERM "$MUT_PID" 2>/dev/null
+    wait "$MUT_PID" || fail=1
+    python -m mpi_knn_tpu metrics --flight "$MUT_TMP/flight.jsonl" \
+        --validate || fail=1
+    python -m mpi_knn_tpu metrics "$MUT_TMP/metrics.json" --check || fail=1
+else
+    echo "mutation gate: server failed to come up"
+    kill "$MUT_PID" 2>/dev/null
+    fail=1
+fi
+
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -64,7 +64,16 @@ def test_full_matrix_is_clean(target):
     )
     assert set(res.stages) == {"before_opt", "after_opt"}
     ran = set(res.rules_run)
-    assert {"R2-memory", "R3-dtype", "R4-collective"} <= ran
+    assert {"R2-memory", "R3-dtype"} <= ran
+    if target.mutate and target.backend == "ivf-sharded":
+        # GSPMD-partitioned mutation scatter: no candidate exchange to
+        # account, so R4 registers out of scope (rules.R4Collectives)
+        assert "R4-collective" not in ran
+    else:
+        assert "R4-collective" in ran
+    if target.mutate:
+        # the mutation cells' own contract: donated in-place update
+        assert "R5-donation" in ran
     if target.backend in ("ring", "ring-overlap"):
         assert "R1-overlap" in ran
     else:
@@ -737,3 +746,157 @@ def test_r4_quant_flags_float_width_rotation_and_missing_scale_permute():
     findings, _ = engine.run_rules(texts, ctx, _rules("R4-collective"))
     assert any("wire-dtype budget" in f.message for f in findings)
     assert any("expected exactly 3" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Live-mutation counterexamples (ISSUE 14): the injected broken mutation
+# programs must FIRE through the production rule path — an un-donated
+# store update, a full-store copy, and the headroom-overflow full-store
+# gather. The clean cells are certified by the default-matrix sweep
+# (mutate-upsert/delete/compact above).
+
+
+def _mutate_ctx(kind="upsert", **meta):
+    """A mutation-cell context at the production meta shape
+    (analysis/lowering._lower_mutate)."""
+    meta.setdefault("q_tile", 32)
+    meta.setdefault("c_tile", 32)
+    meta.setdefault("acc_bytes", 4)
+    meta.setdefault("mutate", kind)
+    meta.setdefault("strict_exempt_ops", (
+        "scatter", "dynamic-update-slice", "fusion", "bitcast", "reshape",
+    ))
+    return engine.LintContext(
+        target=lowering.LintTarget("ivf", "l2", "float32", mutate=kind),
+        cfg=KNNConfig(k=4, partitions=8, nprobe=2, query_tile=8),
+        meta=meta,
+    )
+
+
+def _lint_mutation_index():
+    cfg = lowering._ivf_cfg(
+        lowering.LintTarget("ivf", "l2", "float32", mutate="upsert")
+    )
+    return lowering._ivf_lint_index(cfg)
+
+
+def test_mutation_counterexample_undonated_store_fires_r5():
+    """The SAME upsert program lowered WITHOUT donation: the compiled
+    module carries no input_output_alias, so every chunk would allocate
+    a fresh store — R5 must fire on the after-opt stage through the
+    production rule path."""
+    import jax
+
+    from mpi_knn_tpu.ivf.mutate import UPSERT_DONATED, ivf_upsert_chunk
+    from mpi_knn_tpu.serve.mutate import _mutation_chunk_specs
+
+    index = _lint_mutation_index()
+    undonated = jax.jit(ivf_upsert_chunk, static_argnames=("cfg",))
+    chunk = [
+        jax.ShapeDtypeStruct(s, d)
+        for s, d in _mutation_chunk_specs(index, index.cfg, 32, "upsert")
+    ]
+    lowered = undonated.lower(
+        chunk[0], chunk[1], chunk[2], chunk[3], chunk[4], chunk[5],
+        index.buckets, index.bucket_ids, index.bucket_sqs,
+        index.bucket_scales, cfg=index.cfg,
+    )
+    texts = lowering.hlo_texts(lowered)
+    ctx = _mutate_ctx(
+        donated_params=UPSERT_DONATED,
+        resident_bytes=lowering.serve_resident_bytes(index),
+        budget_elems=32 * lowering.LINT_D,
+    )
+    findings, ran = engine.run_rules(texts, ctx, _rules("R5-donation"))
+    assert ran == ["R5-donation"]
+    assert any(
+        "no donation" in f.message or "no input_output_alias" in f.message
+        or "carry\nno input_output_alias" in f.message
+        or "carry " in f.message
+        for f in findings
+    ), [f.message for f in findings]
+    # and the PRODUCTION (donated) program is clean under the same ctx
+    from mpi_knn_tpu.serve.mutate import lower_mutation
+
+    good = lowering.hlo_texts(lower_mutation(index, index.cfg, 32, "upsert"))
+    ok_findings, _ = engine.run_rules(good, ctx, _rules("R5-donation"))
+    assert not ok_findings, [f.message for f in ok_findings]
+
+
+_MUT_BODY = """\
+
+ENTRY %main.1 (p.1: s32[32], s.1: s32[32], b.1: f32[8,64,32]) -> f32[8,64,32] {
+  %p.1 = s32[32]{0} parameter(0)
+  %s.1 = s32[32]{0} parameter(1)
+  %b.1 = f32[8,64,32]{2,1,0} parameter(2)
+  %cp.1 = f32[8,64,32]{2,1,0} copy(%b.1)
+  ROOT %r.1 = f32[8,64,32]{2,1,0} bitcast(%cp.1)
+}
+"""
+_MUT_LAYOUT = (
+    "entry_computation_layout={(s32[32]{0}, s32[32]{0}, "
+    "f32[8,64,32]{2,1,0})->f32[8,64,32]{2,1,0}}"
+)
+
+
+def test_mutation_counterexample_full_store_copy_fires_census():
+    """A mutation program that COPIES the whole resident store per chunk
+    (instead of scattering in place) re-pays the corpus every mutation —
+    the R5 copy census must fire even though the alias header is clean."""
+    mod = (
+        "HloModule m, input_output_alias={ {}: (2, {}, may-alias) }, "
+        + _MUT_LAYOUT + _MUT_BODY
+    )
+    store_bytes = 8 * 64 * 32 * 4
+    findings, _ = engine.run_rules(
+        {"after_opt": mod},
+        _mutate_ctx(donated_params=(2,), resident_bytes=store_bytes,
+                    budget_elems=32 * 32),
+        _rules("R5-donation"),
+    )
+    assert any("re-copied every batch" in f.message
+               or "resident" in f.message for f in findings), (
+        [f.message for f in findings]
+    )
+
+
+def test_mutation_counterexample_overflow_gather_fires_r2_strict():
+    """The headroom-overflow shape: a 'mutation' program that gathers
+    the FULL store to rebuild it (what growing shapes would force)
+    materializes store-sized payload against a touched-chunk budget —
+    R2-strict must fire on the gather, which is deliberately NOT in the
+    in-place exemption set."""
+    import jax
+    import jax.numpy as jnp
+
+    index = _lint_mutation_index()
+    P, cap, d = (index.buckets.shape[0], index.bucket_cap,
+                 index.buckets.shape[-1])
+
+    def overflow_upsert(rows, part, slot, buckets):
+        flat = buckets.reshape(-1, d)
+        # a store-sized gather: every slot re-fetched to rebuild
+        all_rows = flat[jnp.arange(P * cap) % (P * cap)]
+        rebuilt = all_rows.reshape(P, cap, d)
+        return rebuilt.at[part, slot].set(rows, mode="drop")
+
+    lowered = jax.jit(overflow_upsert, donate_argnums=(3,)).lower(
+        jax.ShapeDtypeStruct((32, d), jnp.float32),
+        jax.ShapeDtypeStruct((32,), jnp.int32),
+        jax.ShapeDtypeStruct((32,), jnp.int32),
+        index.buckets,
+    )
+    texts = lowering.hlo_texts(lowered)
+    ctx = _mutate_ctx(budget_elems=32 * d, donated_params=(3,),
+                      resident_bytes=lowering.serve_resident_bytes(index))
+    findings, _ = engine.run_rules(texts, ctx, _rules("R2-memory"))
+    assert any(
+        f.rule == "R2-memory" and "gather" in f.message
+        for f in findings
+    ), [f.message for f in findings]
+    # the production upsert program fits the SAME touched-chunk budget
+    from mpi_knn_tpu.serve.mutate import lower_mutation
+
+    good = lowering.hlo_texts(lower_mutation(index, index.cfg, 32, "upsert"))
+    ok_findings, _ = engine.run_rules(good, ctx, _rules("R2-memory"))
+    assert not ok_findings, [f.message for f in ok_findings]

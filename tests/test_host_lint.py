@@ -362,7 +362,7 @@ def test_stale_guard_map_is_a_problem(tmp_path):
 
 
 def test_production_sweep_clean_via_cli(tmp_path, capsys):
-    """``mpi-knn lint --host``: exit 0 over all six threaded-module
+    """``mpi-knn lint --host``: exit 0 over all seven threaded-module
     targets, zero non-waived findings, waivers enumerated with
     rationale, and the lock-acquisition graph asserted acyclic FROM THE
     REPORT (the ISSUE 13 acceptance)."""
@@ -374,11 +374,12 @@ def test_production_sweep_clean_via_cli(tmp_path, capsys):
     assert doc["ok"] is True
     assert doc["summary"]["findings"] == 0
     assert doc["summary"]["problems"] == 0
-    # all six targets, each individually ok
+    # all seven targets, each individually ok (serve.mutate joined in
+    # ISSUE 14: the background compactor thread)
     names = {t["name"] for t in doc["targets"]}
     assert names == {
-        "frontend", "serve.engine", "serve.aotcache", "obs.metrics",
-        "obs.spans", "resilience.worker",
+        "frontend", "serve.engine", "serve.mutate", "serve.aotcache",
+        "obs.metrics", "obs.spans", "resilience.worker",
     }
     assert all(t["ok"] for t in doc["targets"])
     # the lock graph is present, non-trivial, and acyclic
@@ -691,7 +692,7 @@ def test_report_shape_and_save(tmp_path):
         "H1-lock-discipline", "H2-lock-order", "H3-confinement",
         "H4-atomic-publish",
     }
-    assert doc["summary"]["targets"] == 6
+    assert doc["summary"]["targets"] == 7
     assert doc["summary"]["classes_checked"] >= 15
     s = doc["summary"]
     assert s["lock_graph_acyclic"] and s["findings"] == 0

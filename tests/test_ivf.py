@@ -631,10 +631,17 @@ def test_default_ivf_lint_cells_are_clean():
     from mpi_knn_tpu.analysis import engine, lowering
 
     targets = [t for t in lowering.default_targets() if t.backend == "ivf"]
-    plain = [t for t in targets if not t.quant]
+    plain = [t for t in targets if not t.quant and not t.mutate]
     assert len(plain) == 6, targets
     assert sorted(t.ladder for t in plain) == [
         "", "", "", "", "bucket", "nprobe",
+    ]
+    # the live-mutation cells (ISSUE 14) ride the same sweep but carry
+    # their own contract (R5 donation on the scatter programs, R2-strict
+    # touched-set budget; R6's probe discipline has no dot to check) —
+    # certified in depth by tests/test_mutation.py + test_hlo_lint.py
+    assert sorted(t.mutate for t in targets if t.mutate) == [
+        "compact", "delete", "upsert",
     ]
     # the quantized at-rest cells (ISSUE 9): int8 one-shot × both
     # policies, int4 one-shot, int8 mixed serve — certified in depth by
@@ -651,7 +658,11 @@ def test_default_ivf_lint_cells_are_clean():
         res = engine.lint_target(t)
         assert res.skipped is None, (t.label, res.skipped)
         assert res.ok, (t.label, [f.message for f in res.findings])
-        assert "R6-ivf-probe" in res.rules_run
+        if t.mutate:
+            assert "R5-donation" in res.rules_run
+            assert "R6-ivf-probe" not in res.rules_run
+        else:
+            assert "R6-ivf-probe" in res.rules_run
         if t.serve:
             assert "R5-donation" in res.rules_run
 

@@ -661,11 +661,14 @@ def test_default_sharded_lint_cells_are_clean():
         t for t in lowering.default_targets()
         if t.backend == "ivf-sharded"
     ]
-    plain = [t for t in targets if not t.quant]
+    plain = [t for t in targets if not t.quant and not t.mutate]
     assert len(plain) == 5, targets
     assert sorted(t.ladder for t in plain) == [
         "", "", "", "", "nprobe",
     ]
+    # the sharded live-mutation cell (ISSUE 14): the donated GSPMD
+    # scatter — R5's aliasing contract must survive the partitioner
+    assert [t.mutate for t in targets if t.mutate] == ["upsert"]
     # plus the quantized-exchange cells (ISSUE 9: rows ride the
     # all-to-alls as int8 code lanes + a fifth scales collective)
     assert sorted((t.quant, t.serve) for t in targets if t.quant) == [
@@ -676,6 +679,11 @@ def test_default_sharded_lint_cells_are_clean():
         assert res.skipped is None, (t.label, res.skipped)
         assert res.ok, (t.label, [f.message for f in res.findings])
         ran = set(res.rules_run)
+        if t.mutate:
+            assert "R5-donation" in ran
+            assert "R4-collective" not in ran  # GSPMD scatter, no
+            # exchange to account (rules.R4Collectives.applies)
+            continue
         assert {"R2-memory", "R4-collective", "R6-ivf-probe"} <= ran
         if t.serve:
             assert "R5-donation" in ran
