@@ -74,6 +74,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "— analysis/host; jax-free, writes "
                    "host_report.json). All other flags are the host "
                    "linter's own (--rule/--out/--list-rules/-q)")
+    p.add_argument("--memory", action="store_true",
+                   help="maintain the per-cell peak-HBM ledger (ISSUE "
+                   "15): after the sweep, write every checked cell's "
+                   "R7 liveness numbers (peak live bytes, attribution, "
+                   "largest-temp culprit, PJRT cross-check) into the "
+                   "committed ledger — new cells extend it, re-lowered "
+                   "cells refresh it. With --ledger-check, COMPARE "
+                   "instead of write")
+    p.add_argument("--ledger-check", action="store_true",
+                   help="with --memory: fail (exit 1) when any cell's "
+                   "peak drifts beyond the committed ledger's "
+                   "tolerance in either direction (growth = "
+                   "regression, shrinkage = stale ledger), or when a "
+                   "committed cell vanished from a full-matrix sweep; "
+                   "never writes")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="ledger path (default: <--out>/"
+                   "memory_ledger.json)")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -168,12 +186,82 @@ def main(argv=None) -> int:
             print(f"  {res.target.label}: {state} "
                   f"[{', '.join(res.rules_run)}]")
 
+    if args.ledger_check and not args.memory:
+        print("error: --ledger-check requires --memory", file=sys.stderr)
+        return 2
+    if args.memory and args.rule and "R7-peak-memory" not in args.rule:
+        # the ledger is R7's output; a sweep that filters it out would
+        # silently write/check an EMPTY ledger — refuse loudly
+        print("error: --memory needs rule R7-peak-memory in the sweep "
+              "(drop --rule or include it)", file=sys.stderr)
+        return 2
+
     try:
         report = run_matrix(targets, rule_names=args.rule, progress=progress)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
     path = report.save(args.out)
+
+    ledger_rc = 0
+    if args.memory:
+        import pathlib
+
+        from mpi_knn_tpu.analysis import memory as memmod
+
+        ledger_path = pathlib.Path(
+            args.ledger if args.ledger
+            else pathlib.Path(args.out) / "memory_ledger.json"
+        )
+        cells = {
+            r.target.label: r.memory
+            for r in report.results
+            if r.skipped is None and r.memory is not None
+        }
+        # a filtered sweep covers a subset: vanished-cell semantics only
+        # apply when every default cell was attempted — and a cell whose
+        # lowering was environment-skipped THIS run (e.g. ring cells on
+        # a one-device mesh) is a coverage gap, never a "vanished"
+        # regression or a purge candidate
+        full_matrix = len(targets) == len(default_targets())
+        skipped_labels = {
+            r.target.label for r in report.results
+            if r.skipped is not None
+        }
+        try:
+            committed = memmod.load_ledger(ledger_path)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.ledger_check:
+            if committed is None:
+                print(f"error: no committed ledger at {ledger_path} "
+                      "(generate one with `mpi-knn lint --memory`)",
+                      file=sys.stderr)
+                return 2
+            drift = memmod.ledger_drift(
+                committed, cells, full_matrix=full_matrix,
+                skipped_labels=skipped_labels,
+            )
+            for why in drift:
+                print(f"  LEDGER-DRIFT {why}")
+            if not args.quiet:
+                print(f"ledger check: {len(cells)} cell(s) vs "
+                      f"{ledger_path}: "
+                      + ("GREEN" if not drift
+                         else f"{len(drift)} drift finding(s)"))
+            ledger_rc = 0 if not drift else 1
+        else:
+            memmod.save_ledger(
+                ledger_path, cells,
+                merge_into=memmod.merge_base_for(
+                    committed, full_matrix=full_matrix,
+                    skipped_labels=skipped_labels,
+                ),
+            )
+            if not args.quiet:
+                print(f"ledger: {len(cells)} cell(s) written to "
+                      f"{ledger_path}")
 
     if not args.quiet:
         s = report.to_json()["summary"]
@@ -184,7 +272,7 @@ def main(argv=None) -> int:
         )
         for f in report.findings:
             print(f"  VIOLATION [{f.rule}] {f.target} {f.stage}: {f.message}")
-    return 0 if report.ok else 1
+    return max(0 if report.ok else 1, ledger_rc)
 
 
 if __name__ == "__main__":

@@ -47,6 +47,10 @@ class TargetResult:
     rules_run: list[str] = field(default_factory=list)
     stages: list[str] = field(default_factory=list)
     skipped: str | None = None  # UnsupportedTarget reason
+    # R7's per-cell memory ledger entry (peak live bytes, attribution,
+    # budget, PJRT cross-check numbers) — populated whenever the
+    # peak-memory rule ran on the after-opt stage (analysis.memory)
+    memory: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -70,6 +74,7 @@ class TargetResult:
             "rules_run": self.rules_run,
             "stages": self.stages,
             "findings": [f.to_json() for f in self.findings],
+            "memory": self.memory,
         }
 
 
@@ -144,8 +149,11 @@ def lint_target(
         res.skipped = str(e)
         return res
     res.stages = list(texts)
-    ctx = LintContext(target=target, cfg=cfg, meta=meta)
+    # a per-run copy: lower_target's meta is lru_cached and shared across
+    # runs, and R7 stashes its ledger entry into the context's meta
+    ctx = LintContext(target=target, cfg=cfg, meta=dict(meta))
     res.findings, res.rules_run = run_rules(texts, ctx, rules)
+    res.memory = ctx.meta.get("r7_analysis")
     return res
 
 

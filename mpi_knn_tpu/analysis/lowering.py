@@ -329,10 +329,23 @@ def _require_x64(target: LintTarget) -> None:
 
 def hlo_texts(lowered) -> dict[str, str]:
     """Both pipeline stages from one ``jax.stages.Lowered``."""
-    return {
+    texts, _ = hlo_texts_and_memory(lowered)
+    return texts
+
+
+def hlo_texts_and_memory(lowered):
+    """Both pipeline stages PLUS the compiled executable's PJRT memory
+    stats (args/outputs/alias/temp bytes) from the SAME compile — the
+    honesty anchor R7's liveness analyzer is cross-checked against
+    (analysis.memory); capturing it here costs zero extra compiles."""
+    from mpi_knn_tpu.analysis.memory import pjrt_memory_stats
+
+    compiled = lowered.compile()
+    texts = {
         "before_opt": lowered.compiler_ir(dialect="hlo").as_hlo_text(),
-        "after_opt": lowered.compile().as_text(),
+        "after_opt": compiled.as_text(),
     }
+    return texts, pjrt_memory_stats(compiled)
 
 
 def _lower_serial(target: LintTarget):
@@ -370,6 +383,16 @@ def _lower_serial(target: LintTarget):
     meta = {"q_tile": q_tile, "c_tile": c_tile,
             "acc_bytes": _acc_bytes(target.dtype),
             **_mixed_meta(target, q_tile, c_tile)}
+    if target.dtype == "bfloat16":
+        # R7 allowance, named and measured (ISSUE 15): the bf16-at-rest
+        # corpus and queries upcast ONCE to the f32 accumulation dtype —
+        # XLA materializes both converted arrays whole, so the liveness
+        # peak legitimately carries (m + nq)·d f32 elements beyond the
+        # tile working set. This is exactly the residency cost DESIGN.md
+        # §6 already documents for compute over compressed stores; the
+        # allowance makes it a declared budget line instead of a
+        # largest-input coincidence (the R2-floor audit's point).
+        meta["peak_extra_elems"] = (m + LINT_NQ) * LINT_D
     return lowered, cfg, meta
 
 
@@ -490,6 +513,14 @@ def _lower_pallas(target: LintTarget):
     # before the gather (backends/pallas_backend.py)
     meta = {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4,
             **_mixed_meta(target, q_tile, c_tile)}
+    if target.policy == "mixed":
+        # R7 allowance, named and measured (ISSUE 15): the fused mixed
+        # path stacks every tile's survivor keys/ids before preselecting
+        # back to the global 4k (backends/pallas_backend.py), holding a
+        # q_pad×m-order working set live across the tile loop — a real
+        # cost of the tiles-variant restack, declared here instead of
+        # hiding under R2's input floor
+        meta["peak_extra_elems"] = q_pad * m
     return lowered, cfg, meta
 
 
@@ -997,21 +1028,26 @@ _LOWERERS = {
 @functools.lru_cache(maxsize=None)
 def lower_target(target: LintTarget):
     """(texts_by_stage, cfg, meta) for one matrix cell, cached — the test
-    matrix and the CLI share lowerings within a process."""
+    matrix and the CLI share lowerings within a process. Meta carries the
+    compiled executable's PJRT memory stats (``pjrt_memory``) so R7's
+    liveness analysis is cross-checked against the runtime's own
+    accounting from the very compile that produced the after-opt text."""
     if target.mutate:
         lowered, cfg, meta = _lower_mutate(target)
-        return hlo_texts(lowered), cfg, meta
-    if target.serve:
+    elif target.serve:
         lowered, cfg, meta = _lower_serve(target)
-        return hlo_texts(lowered), cfg, meta
-    try:
-        lowerer = _LOWERERS[target.backend]
-    except KeyError:
-        raise UnsupportedTarget(
-            f"no lowering registered for backend {target.backend!r}"
-        ) from None
-    lowered, cfg, meta = lowerer(target)
-    return hlo_texts(lowered), cfg, meta
+    else:
+        try:
+            lowerer = _LOWERERS[target.backend]
+        except KeyError:
+            raise UnsupportedTarget(
+                f"no lowering registered for backend {target.backend!r}"
+            ) from None
+        lowered, cfg, meta = lowerer(target)
+    texts, pjrt = hlo_texts_and_memory(lowered)
+    if pjrt is not None:
+        meta["pjrt_memory"] = pjrt
+    return texts, cfg, meta
 
 
 # ---------------------------------------------------------------------------

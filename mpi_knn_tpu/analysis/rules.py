@@ -1475,6 +1475,35 @@ class R6IvfProbe(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# R7: peak-HBM certification (ISSUE 15). The analyzer lives in
+# analysis/memory.py (liveness model, aliasing, budget derivation, the
+# PJRT cross-check, the ledger); this class is the registry adapter —
+# the import direction is rules → memory ONLY, so memory.py keeps its
+# own shape readers and can be unit-tested without the rule registry.
+
+from mpi_knn_tpu.analysis import memory as _memory  # noqa: E402
+
+
+@register
+class R7PeakMemory(Rule):
+    name = "R7-peak-memory"
+    description = (
+        "aliasing-aware liveness peak of the after-opt program: peak "
+        "live bytes (def-use intervals, donated scratch counted once, "
+        "while bodies loop-resident, fusions collapsed) must fit the "
+        "budget derived from the cell's index facts, and must agree "
+        "with PJRT's own memory_analysis() within the declared "
+        "tolerance — disagreement is itself a finding"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        return _memory.r7_check(ctx, stage, module, Finding)
+
+
 # registration order follows source position; the registry is presented in
 # rule-number order regardless (R5's helpers sit above R4 in the file so
 # they can share the R2 shape readers)

@@ -357,6 +357,11 @@ class Frontend:
                 "k": ses.cfg.k,
                 "backend": ses.index.backend,
                 "max_batch_rows": self.policy.max_batch_rows,
+                # static peak HBM of the largest built executable
+                # (ISSUE 15): the memory-ledger figure for THIS
+                # deployment's shapes, zero device reads — an operator
+                # sizing a box reads it here next to dim/k/backend
+                "peak_hbm_bytes": posture.get("peak_hbm_bytes", 0),
             }
 
     # -- pump -------------------------------------------------------------

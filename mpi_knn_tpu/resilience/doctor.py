@@ -67,7 +67,11 @@ def _probe_child(platform: str, cache_dir: str | None = None) -> int:
     maybe_beat("devices")
     t0 = time.perf_counter()
     x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
-    y = jax.jit(lambda a: a @ a.T)(x)
+    # the probe keeps the Lowered/Compiled handles: the memory block
+    # below cross-checks the SAME executable the health probe ran, so
+    # one compile serves both verdict lines
+    probe_compiled = jax.jit(lambda a: a @ a.T).lower(x).compile()
+    y = probe_compiled(x)
     device_sync(y)
     probe_s = time.perf_counter() - t0
     maybe_beat("jit")
@@ -112,7 +116,42 @@ def _probe_child(platform: str, cache_dir: str | None = None) -> int:
     maybe_beat("mutation-probe")
     print(json.dumps({"mutation": _mutation_probe()}), flush=True)
     maybe_beat("mutation-done")
+    # fifth stdout line (ISSUE 15): the memory block — the probe
+    # executable's MEASURED memory_analysis() against the static
+    # liveness analyzer's prediction over the same after-opt module
+    # (analysis.memory, the R7 machinery). Disagreement beyond the
+    # declared band means the certification pipeline itself is broken
+    # on this host/jax pair — folded into overall ok.
+    maybe_beat("memory-probe")
+    print(json.dumps({"memory": _memory_probe(probe_compiled)}),
+          flush=True)
+    maybe_beat("memory-done")
     return 0
+
+
+def _memory_probe(compiled) -> dict:
+    """Predict the probe executable's peak live bytes from its after-opt
+    HLO (the R7 liveness analyzer) and cross-check against PJRT's own
+    measured ``memory_analysis()`` — the doctor's evidence that the
+    memory-certification stack tells the truth on THIS host."""
+    from mpi_knn_tpu.analysis.memory import (
+        analyze_module,
+        crosscheck_pjrt,
+        pjrt_memory_stats,
+    )
+
+    measured = pjrt_memory_stats(compiled)
+    if measured is None:
+        return {"ok": False,
+                "reason": "runtime answered no memory_analysis()"}
+    predicted = analyze_module(compiled.as_text())
+    disagreements = crosscheck_pjrt(predicted, measured)
+    return {
+        "ok": not disagreements,
+        "predicted_peak_bytes": predicted.peak_bytes,
+        "measured": measured,
+        "disagreements": disagreements,
+    }
 
 
 def _mutation_probe() -> dict:
@@ -202,6 +241,7 @@ def run_probe(
     metrics = None
     aot_cache = None
     mutation = None
+    memory = None
     if res.ok:
         for line in res.stdout.splitlines():
             try:
@@ -216,6 +256,8 @@ def run_probe(
                 aot_cache = doc["aot_cache"]
             elif isinstance(doc, dict) and "mutation" in doc:
                 mutation = doc["mutation"]
+            elif isinstance(doc, dict) and "memory" in doc:
+                memory = doc["memory"]
     return {
         # the AOT cache block (ISSUE 12): None when no cache dir is
         # configured — absent, not a fake-healthy zero row
@@ -225,9 +267,16 @@ def run_probe(
         # count asserted zero (sustained churn must compile nothing) —
         # a failed mutation probe fails the verdict
         "mutation": mutation,
+        # the memory-certification block (ISSUE 15): the probe
+        # executable's measured memory_analysis() vs the R7 liveness
+        # analyzer's prediction — a disagreement fails the verdict (the
+        # ledger gate would be lying on this host); None-tolerant for
+        # older probe children
+        "memory": memory,
         "ok": bool(
             res.ok and probe is not None
             and (mutation is None or mutation.get("ok", False))
+            and (memory is None or memory.get("ok", False))
         ),
         "status": res.status if probe is not None or not res.ok
         else "crashed",  # rc 0 but no probe line = a broken child

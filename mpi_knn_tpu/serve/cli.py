@@ -519,6 +519,8 @@ def _serve_loaded_index(args, X, source, policy=None) -> int:
 def _stream_and_report(args, session, index, X, source, build_s) -> int:
     """Shared serving tail: stream the query batches, print per-batch
     latency lines, emit the summary/report."""
+    from mpi_knn_tpu.serve.engine import index_peak_hbm_bytes
+
     cfg = session.cfg
     total, stream = _load_query_stream(args, X)
 
@@ -567,6 +569,11 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         if len(lats) else None,
         "latency_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
         if len(lats) else None,
+        # static peak HBM of the largest executable this run built
+        # (ISSUE 15): PJRT buffer-assignment figure, zero device reads
+        # — the serve_peak_hbm_bytes gauge's number, read next to the
+        # throughput it bounds
+        "peak_hbm_bytes": index_peak_hbm_bytes(index),
     }
     if index.backend in ("ivf", "ivf-sharded"):
         summary["partitions"] = index.partitions

@@ -221,6 +221,12 @@ class _BucketExec:
     # compile in this process) or "cache-hit" (revived from the
     # persistent AOT cache, zero XLA work) — warm() reports tally it
     source: str = "compiled"
+    # static peak HBM of this executable (args + outputs − aliased +
+    # temps, from PJRT's own memory_analysis at build time — zero
+    # device reads, ISSUE 15): the serve_peak_hbm_bytes gauge, the
+    # --report summary and /healthz index facts all read this figure;
+    # 0 when the runtime could not answer (absent, never fake)
+    peak_hbm_bytes: int = 0
 
 
 def _acc_dtype(cfg: KNNConfig):
@@ -670,6 +676,16 @@ def _build_executable(
             help="resident bytes of the clustered bucket store "
             "(codes + scales for quantized stores)",
         ).set(index.nbytes_resident)
+    # peak-HBM gauge (ISSUE 15): the max static peak across this
+    # index's built cells, from the executables' own buffer assignment
+    # — the ledger's figure for the production shapes, stamped at
+    # build time with zero device reads (the wire-gauge precedent)
+    reg.gauge(
+        "serve_peak_hbm_bytes",
+        help="static peak live bytes of the largest built serve "
+        "executable (args + outputs − aliased + temps, from PJRT "
+        "memory_analysis at build time)",
+    ).set(max(exec_.peak_hbm_bytes, index_peak_hbm_bytes(index)))
     return exec_
 
 
@@ -733,11 +749,34 @@ def _finish_executable(
         make_carry = scratch_maker(
             qt, q_tile, cfg.k, index.shards, index.mesh, index.axis
         )
+    # the executable's static peak HBM (ISSUE 15) — PJRT answers from
+    # the compiled binary's own buffer assignment, so the figure costs
+    # zero device reads and is identical for a fresh compile and an
+    # AOT-cache revival of the same program
+    from mpi_knn_tpu.analysis.memory import pjrt_memory_stats
+
+    stats = pjrt_memory_stats(compiled)
     return _BucketExec(
         compiled, bucket, q_pad, q_tile, cfg, index.backend,
         q_sharding=qsh, qids=qids, make_carry=make_carry,
         route_cap=route_cap, exchange_bytes=exchange_bytes,
         source=source,
+        peak_hbm_bytes=stats["peak_bytes"] if stats else 0,
+    )
+
+
+def index_peak_hbm_bytes(index) -> int:
+    """The serving peak-HBM figure of one index: the max static peak
+    across its built executables (any of them may run; the binding one
+    is the worst). Zero before the first cell builds — absent, never a
+    fake measurement. Reads the cell cache lock-free like the dispatch
+    path does (values are immutable once inserted)."""
+    return max(
+        # mutation cells share the dict as raw Compiled objects
+        # (serve.mutate) and carry no batch-peak figure — they read 0
+        (getattr(e, "peak_hbm_bytes", 0)
+         for e in list(index._cache.values())),
+        default=0,
     )
 
 
@@ -1152,6 +1191,9 @@ class ServeSession:
                 "rung": self.ladder[self._rung][0],
                 "tenants": sorted(self.tenant_stats),
                 "mutation": dict(self.mutation_stats),
+                # static peak HBM of the largest built cell (ISSUE 15;
+                # a lock-free cache read — no new lock edge from here)
+                "peak_hbm_bytes": index_peak_hbm_bytes(self.index),
             }
 
     def warm(self, sizes, parallel: int | None = None,

@@ -218,6 +218,31 @@ def main(argv=None) -> int:
 
     results = []
 
+    # the committed peak-HBM ledger (ISSUE 15: artifacts/lint/
+    # memory_ledger.json, regenerated by `mpi-knn lint --memory`) — the
+    # serving rows carry the corresponding lint cell's certified peak
+    # next to their throughput, so the trajectory artifact reads
+    # bytes-vs-speed in one place. The figure is the LINT-shape cell's
+    # (the certified program family), stamped with its cell label so
+    # nobody mistakes it for this run's corpus shapes.
+    def ledger_peak(cell_label):
+        try:
+            from mpi_knn_tpu.analysis.memory import (
+                DEFAULT_LEDGER,
+                load_ledger,
+            )
+
+            doc = load_ledger(REPO / DEFAULT_LEDGER)
+        except Exception:
+            doc = None
+        if not doc:
+            return {}
+        cell = doc["cells"].get(cell_label)
+        if cell is None:
+            return {}
+        return {"peak_hbm_bytes": cell["peak_bytes"],
+                "peak_hbm_cell": cell_label}
+
     def record(op, variant, times):
         row = {
             "op": op,
@@ -420,6 +445,7 @@ def main(argv=None) -> int:
             # about the small sample rather than one rank below p99
             "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
             "queries_per_s": round(session.queries_served / wall, 1),
+            **ledger_peak("serial/l2/float32/serve"),
         }
         results.append(row)
         print(f"{'query_knn':16s} {row['variant']:16s} "
@@ -566,6 +592,7 @@ def main(argv=None) -> int:
             "queries_per_s": round(session.queries_served / wall, 1),
             "recall_at_k": round(float(recall), 4),
             "probe_fraction": round(nprobe / P, 4),
+            **ledger_peak("ivf/l2/float32/serve"),
         }
         results.append(row)
         print(f"{'ivf_query':16s} {row['variant']:16s} "
@@ -828,6 +855,7 @@ def main(argv=None) -> int:
                         session.exchange["dropped_total"],
                     "exchange_bytes_total":
                         session.exchange["exchange_bytes_total"],
+                    **ledger_peak("ivf-sharded/l2/float32/serve"),
                 }
                 results.append(row)
                 print(f"{'ivf_sharded_query':16s} {row['variant']:20s} "

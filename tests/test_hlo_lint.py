@@ -64,7 +64,12 @@ def test_full_matrix_is_clean(target):
     )
     assert set(res.stages) == {"before_opt", "after_opt"}
     ran = set(res.rules_run)
-    assert {"R2-memory", "R3-dtype"} <= ran
+    assert {"R2-memory", "R3-dtype", "R7-peak-memory"} <= ran
+    # R7 ran for real: every checked cell banks its ledger numbers with
+    # the PJRT cross-check evidence attached (ISSUE 15)
+    assert res.memory is not None
+    assert res.memory["peak_bytes"] <= res.memory["budget_bytes"]
+    assert res.memory["pjrt"] is not None
     if target.mutate and target.backend == "ivf-sharded":
         # GSPMD-partitioned mutation scatter: no candidate exchange to
         # account, so R4 registers out of scope (rules.R4Collectives)
