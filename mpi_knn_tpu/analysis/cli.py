@@ -82,16 +82,28 @@ def build_parser() -> argparse.ArgumentParser:
                    "committed ledger — new cells extend it, re-lowered "
                    "cells refresh it. With --ledger-check, COMPARE "
                    "instead of write")
+    p.add_argument("--cost", action="store_true",
+                   help="maintain the per-cell cost ledger (ISSUE 16): "
+                   "after the sweep, write every checked cell's R8 "
+                   "numbers (MXU FLOPs cross-checked against the "
+                   "closed form, modeled HBM traffic, wire-priced ICI "
+                   "bytes, roofline q/s under the default profile) "
+                   "into the committed ledger — new cells extend it, "
+                   "re-lowered cells refresh it. With --ledger-check, "
+                   "COMPARE instead of write")
     p.add_argument("--ledger-check", action="store_true",
-                   help="with --memory: fail (exit 1) when any cell's "
-                   "peak drifts beyond the committed ledger's "
-                   "tolerance in either direction (growth = "
-                   "regression, shrinkage = stale ledger), or when a "
-                   "committed cell vanished from a full-matrix sweep; "
-                   "never writes")
+                   help="with --memory and/or --cost: fail (exit 1) "
+                   "when any cell's ledgered metric drifts beyond the "
+                   "committed ledger's tolerance in either direction "
+                   "(growth = regression, shrinkage = stale ledger), "
+                   "or when a committed cell vanished from a "
+                   "full-matrix sweep; never writes")
     p.add_argument("--ledger", default=None, metavar="PATH",
-                   help="ledger path (default: <--out>/"
+                   help="memory ledger path (default: <--out>/"
                    "memory_ledger.json)")
+    p.add_argument("--cost-ledger", default=None, metavar="PATH",
+                   help="cost ledger path (default: <--out>/"
+                   "cost_ledger.json)")
     p.add_argument("--rule", action="append", metavar="NAME",
                    help="run only the named rule(s), e.g. R2-memory; "
                    "repeatable")
@@ -186,15 +198,20 @@ def main(argv=None) -> int:
             print(f"  {res.target.label}: {state} "
                   f"[{', '.join(res.rules_run)}]")
 
-    if args.ledger_check and not args.memory:
-        print("error: --ledger-check requires --memory", file=sys.stderr)
+    if args.ledger_check and not (args.memory or args.cost):
+        print("error: --ledger-check requires --memory or --cost",
+              file=sys.stderr)
         return 2
-    if args.memory and args.rule and "R7-peak-memory" not in args.rule:
-        # the ledger is R7's output; a sweep that filters it out would
-        # silently write/check an EMPTY ledger — refuse loudly
-        print("error: --memory needs rule R7-peak-memory in the sweep "
-              "(drop --rule or include it)", file=sys.stderr)
-        return 2
+    # each ledger is its rule's output; a sweep that filters the rule out
+    # would silently write/check an EMPTY ledger — refuse loudly
+    for flag, flagname, rule in (
+        (args.memory, "--memory", "R7-peak-memory"),
+        (args.cost, "--cost", "R8-cost"),
+    ):
+        if flag and args.rule and rule not in args.rule:
+            print(f"error: {flagname} needs rule {rule} in the sweep "
+                  "(drop --rule or include it)", file=sys.stderr)
+            return 2
 
     try:
         report = run_matrix(targets, rule_names=args.rule, progress=progress)
@@ -204,20 +221,11 @@ def main(argv=None) -> int:
     path = report.save(args.out)
 
     ledger_rc = 0
-    if args.memory:
+    if args.memory or args.cost:
         import pathlib
 
-        from mpi_knn_tpu.analysis import memory as memmod
+        from mpi_knn_tpu.analysis import ledger as ledgermod
 
-        ledger_path = pathlib.Path(
-            args.ledger if args.ledger
-            else pathlib.Path(args.out) / "memory_ledger.json"
-        )
-        cells = {
-            r.target.label: r.memory
-            for r in report.results
-            if r.skipped is None and r.memory is not None
-        }
         # a filtered sweep covers a subset: vanished-cell semantics only
         # apply when every default cell was attempted — and a cell whose
         # lowering was environment-skipped THIS run (e.g. ring cells on
@@ -228,40 +236,64 @@ def main(argv=None) -> int:
             r.target.label for r in report.results
             if r.skipped is not None
         }
-        try:
-            committed = memmod.load_ledger(ledger_path)
-        except ValueError as e:
-            print(f"error: {e}", file=sys.stderr)
-            return 2
-        if args.ledger_check:
-            if committed is None:
-                print(f"error: no committed ledger at {ledger_path} "
-                      "(generate one with `mpi-knn lint --memory`)",
-                      file=sys.stderr)
+
+        gates = []
+        if args.memory:
+            from mpi_knn_tpu.analysis import memory as memmod
+
+            gates.append((
+                memmod.LEDGER_SPEC,
+                pathlib.Path(args.ledger or
+                             pathlib.Path(args.out) / "memory_ledger.json"),
+                {r.target.label: r.memory for r in report.results
+                 if r.skipped is None and r.memory is not None},
+            ))
+        if args.cost:
+            from mpi_knn_tpu.analysis import cost as costmod
+
+            gates.append((
+                costmod.LEDGER_SPEC,
+                pathlib.Path(args.cost_ledger or
+                             pathlib.Path(args.out) / "cost_ledger.json"),
+                {r.target.label: r.cost for r in report.results
+                 if r.skipped is None and r.cost is not None},
+            ))
+
+        for spec, ledger_path, cells in gates:
+            try:
+                committed = ledgermod.load_ledger(ledger_path, spec)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
                 return 2
-            drift = memmod.ledger_drift(
-                committed, cells, full_matrix=full_matrix,
-                skipped_labels=skipped_labels,
-            )
-            for why in drift:
-                print(f"  LEDGER-DRIFT {why}")
-            if not args.quiet:
-                print(f"ledger check: {len(cells)} cell(s) vs "
-                      f"{ledger_path}: "
-                      + ("GREEN" if not drift
-                         else f"{len(drift)} drift finding(s)"))
-            ledger_rc = 0 if not drift else 1
-        else:
-            memmod.save_ledger(
-                ledger_path, cells,
-                merge_into=memmod.merge_base_for(
-                    committed, full_matrix=full_matrix,
+            if args.ledger_check:
+                if committed is None:
+                    print(f"error: no committed {spec.kind} ledger at "
+                          f"{ledger_path} (generate one with "
+                          f"`{spec.regen_cmd}`)", file=sys.stderr)
+                    return 2
+                drift = ledgermod.ledger_drift(
+                    committed, cells, spec, full_matrix=full_matrix,
                     skipped_labels=skipped_labels,
-                ),
-            )
-            if not args.quiet:
-                print(f"ledger: {len(cells)} cell(s) written to "
-                      f"{ledger_path}")
+                )
+                for why in drift:
+                    print(f"  LEDGER-DRIFT {why}")
+                if not args.quiet:
+                    print(f"{spec.kind} ledger check: {len(cells)} "
+                          f"cell(s) vs {ledger_path}: "
+                          + ("GREEN" if not drift
+                             else f"{len(drift)} drift finding(s)"))
+                ledger_rc = max(ledger_rc, 0 if not drift else 1)
+            else:
+                ledgermod.save_ledger(
+                    ledger_path, cells, spec,
+                    merge_into=ledgermod.merge_base_for(
+                        committed, full_matrix=full_matrix,
+                        skipped_labels=skipped_labels,
+                    ),
+                )
+                if not args.quiet:
+                    print(f"{spec.kind} ledger: {len(cells)} cell(s) "
+                          f"written to {ledger_path}")
 
     if not args.quiet:
         s = report.to_json()["summary"]

@@ -51,6 +51,11 @@ class TargetResult:
     # budget, PJRT cross-check numbers) — populated whenever the
     # peak-memory rule ran on the after-opt stage (analysis.memory)
     memory: dict | None = None
+    # R8's per-cell cost ledger entry (MXU FLOPs + the analytical
+    # cross-check, modeled HBM traffic, wire-priced ICI bytes, roofline
+    # under the default profile) — populated whenever the cost rule ran
+    # on the after-opt stage (analysis.cost)
+    cost: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -75,6 +80,7 @@ class TargetResult:
             "stages": self.stages,
             "findings": [f.to_json() for f in self.findings],
             "memory": self.memory,
+            "cost": self.cost,
         }
 
 
@@ -154,6 +160,7 @@ def lint_target(
     ctx = LintContext(target=target, cfg=cfg, meta=dict(meta))
     res.findings, res.rules_run = run_rules(texts, ctx, rules)
     res.memory = ctx.meta.get("r7_analysis")
+    res.cost = ctx.meta.get("r8_analysis")
     return res
 
 

@@ -313,6 +313,47 @@ def _mixed_meta(target: LintTarget, q_tile: int, c_tile: int):
     return {"extra_elems": q_tile * overfetch_width(LINT_K, c_tile) * LINT_D}
 
 
+def _dense_cost(target: LintTarget, q: int, c: int, c_tile: int, *,
+                queries: int, sites: int = 1, trips: int = 1,
+                rblocks: int | None = None) -> dict:
+    """R8's declared FLOP facts for a dense cell (analysis/cost.py's
+    ``dense`` scheme): the padded per-execution distance-dot extents,
+    the schedule's site/trip structure, and — on mixed cells — the
+    rerank overfetch width and how many rerank blocks run per site-trip
+    (per corpus tile for the serial two-pass, one global block for the
+    fused pallas path). ``queries`` is the REAL (unpadded) queries
+    answered per execution — the roofline's q/s numerator."""
+    from mpi_knn_tpu.ops.rerank import overfetch_width
+
+    facts = {"scheme": "dense", "q": int(q), "c": int(c), "d": LINT_D,
+             "sites": sites, "trips": trips, "queries": int(queries)}
+    if target.policy == "mixed":
+        facts["w"] = overfetch_width(LINT_K, c_tile)
+        facts["rblocks"] = (
+            rblocks if rblocks is not None else int(c) // int(c_tile)
+        )
+    return facts
+
+
+def _ivf_cost(index, cfg: KNNConfig, q: int, *, queries: int) -> dict:
+    """R8's declared FLOP facts for a clustered cell (analysis/cost.py's
+    ``ivf`` scheme): centroid scoring plus the probed-width gather dot,
+    with ``q`` the per-device padded query rows (per-shard for the
+    sharded layout — the after-opt module is the per-device program)."""
+    from mpi_knn_tpu.ops.rerank import mixed_applies, overfetch_width
+
+    v = cfg.nprobe * index.bucket_cap
+    facts = {
+        "scheme": "ivf", "q": int(q), "d": index.dim,
+        "partitions": index.partitions, "nprobe": cfg.nprobe,
+        "bucket_cap": index.bucket_cap, "queries": int(queries),
+    }
+    if cfg.precision_policy == "mixed" and mixed_applies(cfg.k, v):
+        facts["w"] = overfetch_width(cfg.k, v)
+        facts["rblocks"] = 1
+    return facts
+
+
 def _acc_bytes(dtype: str) -> int:
     return 8 if dtype == "float64" else 4
 
@@ -380,8 +421,11 @@ def _lower_serial(target: LintTarget):
         carry_i.reshape(qt, q_tile, cfg.k),
         cfg,
     )
+    m_pad = int(c_tiles.shape[0]) * c_tile
     meta = {"q_tile": q_tile, "c_tile": c_tile,
             "acc_bytes": _acc_bytes(target.dtype),
+            "cost": _dense_cost(target, q_pad, m_pad, c_tile,
+                                queries=LINT_NQ),
             **_mixed_meta(target, q_tile, c_tile)}
     if target.dtype == "bfloat16":
         # R7 allowance, named and measured (ISSUE 15): the bf16-at-rest
@@ -455,6 +499,19 @@ def _lower_ring(target: LintTarget):
             (6 if target.schedule == "bidir" else 3) if quantized
             else (4 if target.schedule == "bidir" else 2)
         ),
+        # per-device FLOP facts: queries shard over the ring (1-D mesh)
+        # or the dp axis (2-D), the corpus block rotates; bidir runs two
+        # dot sites (both travelers) for ⌊P/2⌋+1 scan trips
+        "cost": _dense_cost(
+            target,
+            q_pad // (dp if q_axis is not None else ring_n),
+            c_pad // ring_n,
+            c_tile,
+            queries=LINT_NQ,
+            sites=2 if target.schedule == "bidir" else 1,
+            trips=(ring_n // 2 + 1 if target.schedule == "bidir"
+                   else ring_n),
+        ),
         **_mixed_meta(target, q_tile, c_tile),
     }
     if quantized:
@@ -512,6 +569,10 @@ def _lower_pallas(target: LintTarget):
     # survivor lists are preselected back down to 4k on compressed keys
     # before the gather (backends/pallas_backend.py)
     meta = {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4,
+            # the fused path reranks ONE global overfetch block, not one
+            # per corpus tile (the tile survivors are preselected first)
+            "cost": _dense_cost(target, q_pad, c_pad, c_tile,
+                                queries=LINT_NQ, rblocks=1),
             **_mixed_meta(target, q_tile, c_tile)}
     if target.policy == "mixed":
         # R7 allowance, named and measured (ISSUE 15): the fused mixed
@@ -575,7 +636,8 @@ def _ivf_lint_index(cfg: KNNConfig):
     return build_ivf_index(data, cfg)
 
 
-def _ivf_meta(index, cfg: KNNConfig, q_tile: int) -> dict:
+def _ivf_meta(index, cfg: KNNConfig, q_tile: int, q_pad: int,
+              queries: int) -> dict:
     v = cfg.nprobe * index.bucket_cap
     meta = {
         "q_tile": q_tile,
@@ -583,6 +645,7 @@ def _ivf_meta(index, cfg: KNNConfig, q_tile: int) -> dict:
         "acc_bytes": 4,
         "partitions": index.partitions,
         "dim": index.dim,
+        "cost": _ivf_cost(index, cfg, q_pad, queries=queries),
         # R2 STRICT mode: the probe gather is the declared budget — the
         # program must not materialize beyond nprobe·bucket_cap·d per
         # query row (the sublinear claim, machine-checked)
@@ -632,7 +695,7 @@ def _lower_ivf(target: LintTarget):
         cfg,
         cfg.nprobe,
     )
-    return lowered, cfg, _ivf_meta(index, cfg, q_tile)
+    return lowered, cfg, _ivf_meta(index, cfg, q_tile, q_pad, LINT_NQ)
 
 
 # sharded-IVF lint shapes: the same trained 256-row/8-partition index,
@@ -658,7 +721,7 @@ def _ivf_sharded_lint_index(cfg: KNNConfig):
 
 
 def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
-                      route_cap: int) -> dict:
+                      route_cap: int, q_pad: int, queries: int) -> dict:
     from mpi_knn_tpu.ivf.sharded import (
         exchange_bytes_per_tile,
         exchange_elems,
@@ -676,6 +739,10 @@ def _ivf_sharded_meta(index, cfg: KNNConfig, q_tile: int,
         "dim": index.dim,
         "shards": index.shards,
         "route_cap": route_cap,
+        # per-SHARD FLOP facts: q_pad is the global padded batch, every
+        # shard runs the same program over its q_pad/shards slice
+        "cost": _ivf_cost(index, cfg, q_pad // index.shards,
+                          queries=queries),
         # R4: the candidate exchange is exactly these all-to-alls
         # (request table + rows/ids/norms returns; a quantized store adds
         # the scales return), full-ring groups, payload bytes inside this
@@ -760,7 +827,8 @@ def _lower_ivf_sharded(target: LintTarget):
         index.shards,
         route_cap,
     )
-    return lowered, cfg, _ivf_sharded_meta(index, cfg, q_tile, route_cap)
+    return lowered, cfg, _ivf_sharded_meta(index, cfg, q_tile, route_cap,
+                                           q_pad, LINT_NQ)
 
 
 def _lower_serve(target: LintTarget):
@@ -808,7 +876,8 @@ def _lower_serve(target: LintTarget):
             index.shards,
         )
         meta = {
-            **_ivf_sharded_meta(index, cfg, q_tile, route_cap),
+            **_ivf_sharded_meta(index, cfg, q_tile, route_cap, q_pad,
+                                bucket),
             "serve": True,
             "donated_params": SHARDED_SCRATCH_PARAMS if cfg.donate else (),
             "resident_bytes": serve_resident_bytes(index),
@@ -832,7 +901,7 @@ def _lower_serve(target: LintTarget):
         cfg = index.compatible_cfg(cfg)
         lowered, q_pad, q_tile = lower_bucket(index, cfg, bucket)
         meta = {
-            **_ivf_meta(index, cfg, q_tile),
+            **_ivf_meta(index, cfg, q_tile, q_pad, bucket),
             "serve": True,
             "donated_params": SCRATCH_PARAMS if cfg.donate else (),
             "resident_bytes": serve_resident_bytes(index),
@@ -889,10 +958,30 @@ def _lower_serve(target: LintTarget):
             "coalesced_tenants": len(cb.tenants),
         }
     lowered, q_pad, q_tile = lower_bucket(index, index.cfg, bucket)
+    if target.backend in RING_BACKENDS:
+        q_axis, _raxis, dp, ring_n = index.ring_meta
+        cost = _dense_cost(
+            target,
+            q_pad // (dp if q_axis is not None else ring_n),
+            index.corpus_sharded.shape[0] // ring_n,
+            index.c_tile,
+            queries=bucket,
+            sites=2 if target.schedule == "bidir" else 1,
+            trips=(ring_n // 2 + 1 if target.schedule == "bidir"
+                   else ring_n),
+        )
+    elif target.backend == "pallas":
+        cost = _dense_cost(target, q_pad, index.corpus_padded.shape[0],
+                           index.c_tile, queries=bucket, rblocks=1)
+    else:
+        cost = _dense_cost(target, q_pad,
+                           int(index.tiles.shape[0]) * index.c_tile,
+                           index.c_tile, queries=bucket)
     meta = {
         "q_tile": q_tile,
         "c_tile": index.c_tile,
         "acc_bytes": _acc_bytes(target.dtype),
+        "cost": cost,
         "serve": True,
         # R5: the scratch params MUST carry the donation in the header,
         # and nothing in the batch program may copy the resident corpus
@@ -995,6 +1084,9 @@ def _lower_mutate(target: LintTarget):
         "c_tile": c_tile,
         "acc_bytes": 4,
         "mutate": kind,
+        # mutation programs move rows, they do not score them: no dots
+        # by design, and R8 certifies exactly that
+        "cost": {"scheme": "zero", "queries": bucket},
         # R5: the donated store params MUST alias every output, and the
         # program must not copy the resident corpus
         "donated_params": donated,

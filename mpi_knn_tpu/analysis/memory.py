@@ -69,6 +69,7 @@ import pathlib
 import re
 from dataclasses import dataclass, field
 
+from mpi_knn_tpu.analysis import ledger as _ledger
 from mpi_knn_tpu.utils.hlo_graph import HloModule, parse_hlo
 
 # ---------------------------------------------------------------------------
@@ -616,19 +617,37 @@ def ledger_entry(analysis: MemoryAnalysis, budget: int,
     }
 
 
+def _peak_culprit(cell: dict) -> str:
+    culprit = cell.get("largest_temp", {})
+    return (
+        f"largest temp {culprit.get('bytes')}B {culprit.get('op')!r} "
+        f"at {culprit.get('instruction')!r}"
+    )
+
+
+# The R7 ledger as a LedgerSpec — all lifecycle (schema gate, atomic
+# merge-aware save, vanished-cell semantics, tolerance-banded drift) is
+# shared with R8's cost ledger via analysis/ledger.py so the two drift
+# gates cannot diverge. The public functions below keep their original
+# signatures and message text (pinned by tests/test_memory_lint.py).
+LEDGER_SPEC = _ledger.LedgerSpec(
+    kind="memory",
+    schema_version=LEDGER_SCHEMA_VERSION,
+    source="mpi_knn_tpu.analysis.memory",
+    regen_cmd="mpi-knn lint --memory",
+    tol_rel=LEDGER_TOL_REL,
+    tol_abs=LEDGER_TOL_ABS,
+    metrics=(
+        _ledger.MetricSpec(
+            key="peak_bytes", noun="peak", unit="bytes",
+            culprit=_peak_culprit,
+        ),
+    ),
+)
+
+
 def load_ledger(path) -> dict | None:
-    path = pathlib.Path(path)
-    if not path.exists():
-        return None
-    doc = json.loads(path.read_text())
-    if doc.get("schema_version") != LEDGER_SCHEMA_VERSION:
-        raise ValueError(
-            f"memory ledger {path} has schema "
-            f"{doc.get('schema_version')!r}, expected "
-            f"{LEDGER_SCHEMA_VERSION} (regenerate with "
-            "`mpi-knn lint --memory`)"
-        )
-    return doc
+    return _ledger.load_ledger(path, LEDGER_SPEC)
 
 
 def save_ledger(path, cells: dict, merge_into: dict | None = None):
@@ -636,97 +655,33 @@ def save_ledger(path, cells: dict, merge_into: dict | None = None):
     serve process reading it). ``merge_into``: an existing ledger doc
     whose cells this run did not re-lower are preserved, so a filtered
     ``--memory`` sweep refreshes only what it measured."""
-    import jax
-
-    from mpi_knn_tpu.utils.atomicio import atomic_write_text
-
-    path = pathlib.Path(path)
-    merged = dict(merge_into.get("cells", {})) if merge_into else {}
-    merged.update(cells)
-    doc = {
-        "schema_version": LEDGER_SCHEMA_VERSION,
-        "source": "mpi_knn_tpu.analysis.memory",
-        "jax_version": jax.__version__,
-        "platform": jax.default_backend(),
-        "device_count": jax.device_count(),
-        "tolerance": {"rel": LEDGER_TOL_REL, "abs_bytes": LEDGER_TOL_ABS},
-        "cells": {k: merged[k] for k in sorted(merged)},
-    }
-    path.parent.mkdir(parents=True, exist_ok=True)
-    atomic_write_text(path, json.dumps(doc, indent=1) + "\n")
-    return doc
+    return _ledger.save_ledger(path, cells, LEDGER_SPEC,
+                               merge_into=merge_into)
 
 
 def merge_base_for(
     committed: dict | None, *, full_matrix: bool,
     skipped_labels: frozenset | set = frozenset(),
 ) -> dict | None:
-    """What a ``--memory`` WRITE should merge the fresh cells into. A
-    filtered sweep refreshes only what it re-lowered, so the committed
-    ledger is preserved wholesale. A FULL-matrix regeneration must
-    PURGE vanished cells — otherwise the drift gate's prescribed remedy
-    ("regenerate with `mpi-knn lint --memory`" after deleting a cell on
-    purpose) would re-import the dead entry forever — while cells whose
-    lowering was environment-skipped THIS run (a too-small mesh, not a
-    dropped certification) keep their committed entries."""
-    if committed is None:
-        return None
-    if not full_matrix:
-        return committed
-    preserved = {
-        k: v for k, v in committed.get("cells", {}).items()
-        if k in skipped_labels
-    }
-    return {"cells": preserved} if preserved else None
+    """What a ``--memory`` WRITE should merge the fresh cells into (see
+    :func:`mpi_knn_tpu.analysis.ledger.merge_base_for` — shared with the
+    R8 cost ledger)."""
+    return _ledger.merge_base_for(
+        committed, full_matrix=full_matrix, skipped_labels=skipped_labels
+    )
 
 
 def ledger_drift(
     committed: dict, current: dict, *, full_matrix: bool,
     skipped_labels: frozenset | set = frozenset(),
 ) -> list[str]:
-    """Why the current per-cell numbers fail the committed ledger
-    (empty = green). Growth beyond tolerance is a regression; shrinkage
-    beyond tolerance is a stale ledger hiding a banked win — both fail.
-    A NEW cell (current, not committed) extends the ledger and is not a
-    finding; a VANISHED cell (committed, not current) is one — but only
-    on full-matrix runs, where absence means the certification was
-    dropped rather than filtered out, and never for a cell in
-    ``skipped_labels`` (its lowering was environment-skipped this run —
-    e.g. ring cells on a one-device mesh — which is a coverage gap, not
-    a regression)."""
-    out = []
-    committed_cells = committed.get("cells", {})
-    for label in sorted(set(committed_cells) | set(current)):
-        old = committed_cells.get(label)
-        new = current.get(label)
-        if old is None:
-            continue  # new cell: extends the ledger
-        if new is None:
-            if full_matrix and label not in skipped_labels:
-                out.append(
-                    f"{label}: cell vanished from the matrix but is "
-                    "still in the committed ledger — a dropped "
-                    "certification (regenerate the ledger if the cell "
-                    "was removed on purpose)"
-                )
-            continue
-        was, now = old["peak_bytes"], new["peak_bytes"]
-        tol = max(LEDGER_TOL_ABS, was * LEDGER_TOL_REL)
-        if now > was + tol:
-            culprit = new.get("largest_temp", {})
-            out.append(
-                f"{label}: peak grew {was} → {now} bytes "
-                f"(+{now - was}, tolerance {int(tol)}) — largest temp "
-                f"{culprit.get('bytes')}B {culprit.get('op')!r} at "
-                f"{culprit.get('instruction')!r}"
-            )
-        elif now < was - tol:
-            out.append(
-                f"{label}: peak shrank {was} → {now} bytes beyond "
-                "tolerance — the committed ledger is stale; regenerate "
-                "with `mpi-knn lint --memory` to bank the improvement"
-            )
-    return out
+    """Why the current per-cell peaks fail the committed ledger (empty =
+    green; see :func:`mpi_knn_tpu.analysis.ledger.ledger_drift` — shared
+    with the R8 cost ledger)."""
+    return _ledger.ledger_drift(
+        committed, current, LEDGER_SPEC,
+        full_matrix=full_matrix, skipped_labels=skipped_labels,
+    )
 
 
 # ---------------------------------------------------------------------------

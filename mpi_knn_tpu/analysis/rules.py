@@ -1504,6 +1504,35 @@ class R7PeakMemory(Rule):
         return _memory.r7_check(ctx, stage, module, Finding)
 
 
+# R8: the static cost certification. Everything substantive lives in
+# analysis/cost.py (dot-FLOP counter with loop multiplicities, the
+# closed-form exactness contract, the wire-priced collective census,
+# the roofline, the cost ledger); this class is the registry adapter —
+# the import direction is rules → cost ONLY, mirroring R7.
+
+from mpi_knn_tpu.analysis import cost as _cost  # noqa: E402
+
+
+@register
+class R8Cost(Rule):
+    name = "R8-cost"
+    description = (
+        "static cost model of the after-opt program: MXU FLOPs from dot "
+        "shapes × statically-read loop trip counts must EXACTLY equal "
+        "the closed-form count from the cell's declared configuration "
+        "facts (disagreement in either direction is a finding), every "
+        "collective-family opcode must be in the wire-price registry, "
+        "and the FLOP/HBM/ICI totals land in the committed cost ledger "
+        "with a roofline q/s bound under the declared device profile"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx, stage, module) -> list[Finding]:
+        return _cost.r8_check(ctx, stage, module, Finding)
+
+
 # registration order follows source position; the registry is presented in
 # rule-number order regardless (R5's helpers sit above R4 in the file so
 # they can share the R2 shape readers)

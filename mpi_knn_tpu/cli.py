@@ -301,6 +301,14 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.serve.mutate_cli import main as mutate_main
 
         return mutate_main(argv[1:])
+    if argv and argv[0] == "plan":
+        # capacity-planner subcommand (ISSUE 16): invert the committed
+        # R7/R8 ledgers + bench calibration into a serving configuration
+        # for a given corpus/recall/QPS/fleet, or refuse with the named
+        # binding constraint (exit 2). jax-free — answers on any host.
+        from mpi_knn_tpu.plan import main as plan_main
+
+        return plan_main(argv[1:])
     if argv and argv[0] == "doctor":
         # preflight device-health subcommand: tiny jit + device_sync in a
         # heartbeat-supervised subprocess (mpi_knn_tpu.resilience), JSON

@@ -47,6 +47,8 @@ from mpi_knn_tpu.obs import metrics as obs_metrics
 from mpi_knn_tpu.obs import spans as obs_spans
 
 
+
+
 class FrontendError(RuntimeError):
     """The pump died (or the session raised) with requests outstanding;
     carried to every waiting ticket so no client blocks forever."""
@@ -119,6 +121,14 @@ class Frontend:
         self._stop = False
         self._crashed: BaseException | None = None
         self.started_s = time.monotonic()
+        # declared device profile (ISSUE 16), resolved once here —
+        # jax is already loaded by the session, and a construction-time
+        # write keeps the attribute immutable across threads (H1); None
+        # is a legitimate /healthz value (no shipped profile for this
+        # hardware — never a guessed device)
+        from mpi_knn_tpu.analysis.cost import detected_profile
+
+        self._profile_facts: dict | None = detected_profile()
         # cold-start readiness (ISSUE 12): set once start-up warming —
         # executable builds at every rung plus the one-time dispatch-path
         # plumbing — has finished. While unset, admission is PER BUCKET:
@@ -362,7 +372,16 @@ class Frontend:
                 # deployment's shapes, zero device reads — an operator
                 # sizing a box reads it here next to dim/k/backend
                 "peak_hbm_bytes": posture.get("peak_hbm_bytes", 0),
+                # the declared roofline inputs for this hardware
+                # (ISSUE 16): the shipped device profile the planner
+                # predicted q/s under, so measured throughput and its
+                # predicted bar read from the same endpoint; null off
+                # the profile map — never a guessed device
+                "device_profile": self._device_profile(),
             }
+
+    def _device_profile(self) -> dict | None:
+        return self._profile_facts
 
     # -- pump -------------------------------------------------------------
 

@@ -1,0 +1,736 @@
+"""``mpi-knn plan`` — the ledger-driven capacity planner (ISSUE 16).
+
+Inverts the certified static ledgers into configuration: given a corpus
+shape (m, d), k, a recall target, an offered QPS, and a fleet (device
+count, HBM per device, a declared device profile), search the
+configuration space — backend, partitions, bucket_cap, nprobe, at-rest
+dtype, shards, bucket headroom — and emit the exact ``mpi-knn
+build-index`` / ``mpi-knn serve`` commands plus the predicted peak HBM,
+bytes on wire, and roofline q/s. Infeasible inputs are REFUSED with the
+named binding constraint (exit 2, structured JSON): ``recall`` (target
+unreachable even at nprobe == partitions for the permitted dtypes),
+``hbm`` (the smallest feasible layout still overflows a device), or
+``qps`` (offered rate above the roofline of every fitting config).
+
+Predictions are not vibes — every number has a committed source:
+
+- **Peak HBM.** A configuration that is also a lint-matrix cell reads
+  its peak straight out of the committed R7 memory ledger
+  (``artifacts/lint/memory_ledger.json``) — byte-for-byte the certified
+  figure, shared code path (``analysis.memory.load_ledger``), not a
+  re-derivation. Off-matrix shapes use the same budget decomposition R7
+  gates cells with: resident store + query/output buffers at face value
+  + the ``R7_TEMP_SLACK``× working-set temp allowance
+  (``analysis.memory.temp_budget_bytes``) — deliberately conservative,
+  so a booted deployment's measured ``memory_analysis()`` peak (the
+  ``/healthz`` ``peak_hbm_bytes`` figure) lands AT OR UNDER it; the
+  check.sh gate asserts exactly that.
+- **Recall.** Interpolated from the committed bench measurements
+  (``measurements/bench_ops.json``): the ``ivf_query`` rows calibrate
+  recall against probe fraction (nprobe/partitions), the ``ivf_at_rest``
+  rows calibrate the per-dtype quantization cap (int4's ceiling is what
+  makes a recall refusal REAL: no nprobe reaches 0.95 on an int4
+  store). ``nprobe == partitions`` is the exact degenerate scan —
+  recall 1.0 times the dtype cap.
+- **q/s.** The SAME closed-form FLOP counts R8 certifies against
+  after-opt HLO on every matrix cell (``analysis.cost.
+  analytical_mxu_flops``), plus a documented byte-traffic model, fed to
+  the SAME roofline (``analysis.cost.roofline``) under the shipped
+  device profiles. Within a config family the predicted ordering
+  matches the committed CPU baseline's measured ordering (pinned by
+  tests); absolute q/s on real hardware is what the TPU bench round
+  lands against.
+
+This module is jax-free (pure shape math + committed JSON): ``mpi-knn
+plan`` answers instantly on a machine with no accelerator at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import pathlib
+import sys
+
+from mpi_knn_tpu.analysis import cost as _cost
+from mpi_knn_tpu.analysis import memory as _memory
+
+# committed calibration artifacts, anchored at the repo root so the
+# planner (and the doctor's plan probe) answers from any cwd
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_BENCH = _REPO / "measurements" / "bench_ops.json"
+DEFAULT_PLAN_LEDGER = _REPO / _memory.DEFAULT_LEDGER
+
+# The lint matrix's workload shapes, mirrored here so the in-matrix
+# ledger lookup stays jax-free (analysis.lowering imports jax at module
+# scope). Pinned against lowering's constants by tier-1
+# (tests/test_plan.py) — drift breaks the test, never the lookup.
+MATRIX_DENSE = {"m": 128, "d": 32, "k": 4, "bucket": 64}
+MATRIX_IVF = {"m": 256, "d": 32, "k": 4, "bucket": 64,
+              "partitions": 8, "nprobe": 2, "shards": 4}
+
+# k-means skew allowance for the bucket_cap model: the build pads every
+# bucket to the LARGEST cluster (ivf/index.py), so the planner budgets
+# for the largest cluster, not the mean. On blob-structured corpora with
+# partitions well above the natural cluster count the largest cluster
+# runs ~2.4× the mean (measured on the check.sh boot gate's corpus) —
+# 2.5 covers that; the boot gate holds the resulting prediction against
+# the booted deployment's measured peak every CI run.
+KMEANS_IMBALANCE = 2.5
+
+# at-rest store bytes per element (codes; scales are priced separately)
+_STORE_BYTES = {"float32": 4.0, "bfloat16": 2.0, "int8": 1.0, "int4": 0.5}
+
+PLAN_BACKENDS = ("serial", "ring", "ivf", "ivf-sharded")
+PLAN_DTYPES = tuple(_STORE_BYTES)
+
+
+def _pad(n: int, mult: int) -> int:
+    return ((max(1, n) + mult - 1) // mult) * mult
+
+
+# ---------------------------------------------------------------------------
+# recall calibration from the committed bench baseline
+
+
+def load_calibration(path=DEFAULT_BENCH) -> dict:
+    """The planner's recall calibration from the committed bench rows:
+    ``points`` — measured (probe_fraction, recall@k) pairs from the
+    ``ivf_query`` nprobe sweep; ``dtype_scale`` — each at-rest dtype's
+    recall relative to the float32 store at the same nprobe (the
+    quantization cap). Raises ``FileNotFoundError``/``ValueError``
+    loudly — a planner with no calibration must not guess."""
+    doc = json.loads(pathlib.Path(path).read_text())
+    points = sorted(
+        (float(r["probe_fraction"]), float(r["recall_at_k"]))
+        for r in doc["results"]
+        if r.get("op") == "ivf_query" and "recall_at_k" in r
+    )
+    at_rest = {
+        r["variant"].rsplit("-", 1)[-1]: float(r["recall_at_k"])
+        for r in doc["results"]
+        if r.get("op") == "ivf_at_rest" and "recall_at_k" in r
+    }
+    if not points or "float32" not in at_rest:
+        raise ValueError(
+            f"bench baseline {path} carries no ivf_query recall sweep / "
+            "ivf_at_rest float32 row — regenerate it with "
+            "`python scripts/bench_ops.py`"
+        )
+    scale = {
+        dt: rec / at_rest["float32"] for dt, rec in at_rest.items()
+    }
+    return {"points": points, "dtype_scale": scale, "path": str(path)}
+
+
+def predict_recall(fraction: float, dtype: str, calib: dict) -> float:
+    """Recall@k at one probe fraction and at-rest dtype. Log-linear
+    interpolation between the measured fractions (they span 16×, so
+    linear-in-fraction would overweight the top point); fraction 1.0 is
+    the exact degenerate scan (recall 1.0 before the dtype cap); below
+    the smallest measured fraction the first segment's slope
+    extrapolates DOWN (never clamps up — optimism is the failure mode a
+    planner must not have)."""
+    scale = calib["dtype_scale"].get(dtype, 1.0)
+    if fraction >= 1.0:
+        return scale
+    pts = calib["points"] + [(1.0, 1.0)]
+    lo = pts[0]
+    if fraction <= lo[0]:
+        (x0, y0), (x1, y1) = pts[0], pts[1]
+        t = (math.log(fraction) - math.log(x0)) / (
+            math.log(x1) - math.log(x0)
+        )
+        return max(0.0, (y0 + t * (y1 - y0))) * scale
+    for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+        if fraction <= x1:
+            t = (math.log(fraction) - math.log(x0)) / (
+                math.log(x1) - math.log(x0)
+            )
+            return (y0 + t * (y1 - y0)) * scale
+    return scale
+
+
+# ---------------------------------------------------------------------------
+# the candidate configuration and its predicted numbers
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    m: int
+    d: int
+    k: int = 10
+    recall_target: float = 0.95
+    qps: float = 0.0  # offered queries/s the plan must sustain
+    bucket: int = 1024  # serve row bucket (batch size of the roofline)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fleet:
+    devices: int = 1
+    profile: str = _cost.DEFAULT_PROFILE
+    hbm_bytes: int | None = None  # None = the profile's capacity
+    hbm_headroom: float = 0.1  # HBM fraction kept free per device
+
+    def resolved(self) -> dict:
+        prof = _cost.get_profile(self.profile)
+        cap = self.hbm_bytes if self.hbm_bytes is not None \
+            else int(prof["hbm_bytes"])
+        return {**prof, "hbm_bytes": cap,
+                "budget_bytes": int(cap * (1.0 - self.hbm_headroom))}
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    backend: str  # serial | ring | ivf | ivf-sharded
+    dtype: str = "float32"
+    partitions: int | None = None
+    nprobe: int | None = None
+    shards: int | None = None
+    bucket_headroom: float = 0.0
+
+
+class Infeasible(Exception):
+    """No candidate satisfies every constraint. ``constraint`` names the
+    BINDING one: the check that killed the candidate that got furthest
+    (recall → hbm → qps, in evaluation order)."""
+
+    def __init__(self, constraint: str, detail: str, candidate: dict,
+                 rejected: dict):
+        super().__init__(f"{constraint}: {detail}")
+        self.constraint = constraint
+        self.detail = detail
+        self.candidate = candidate
+        self.rejected = rejected
+
+
+def bucket_cap_for(m: int, partitions: int, headroom: float) -> int:
+    """The planner's model of the build's static bucket capacity
+    (ivf/index.py: ``pad(max_cluster · (1 + headroom))`` to a lane
+    multiple of 8), with the largest cluster modeled at
+    ``KMEANS_IMBALANCE``× the mean."""
+    need = math.ceil(m / partitions * KMEANS_IMBALANCE)
+    return _pad(math.ceil(need * (1.0 + headroom)), 8)
+
+
+def _matrix_label(cand: Candidate, wl: Workload) -> str | None:
+    """The lint-matrix serve-cell label this (candidate, workload) pair
+    IS, or None when it is off-matrix. Matching configs read their peak
+    straight from the committed R7 ledger — the byte-for-byte contract
+    of the acceptance criteria."""
+    if cand.dtype != "float32" or cand.bucket_headroom:
+        return None
+    if cand.backend in ("serial", "ring"):
+        ref = MATRIX_DENSE
+        if (wl.m, wl.d, wl.k, wl.bucket) != (
+            ref["m"], ref["d"], ref["k"], ref["bucket"]
+        ):
+            return None
+        return f"{cand.backend}/l2/float32/serve"
+    ref = MATRIX_IVF
+    if (wl.m, wl.d, wl.k, wl.bucket) != (
+        ref["m"], ref["d"], ref["k"], ref["bucket"]
+    ):
+        return None
+    if (cand.partitions, cand.nprobe) != (ref["partitions"],
+                                          ref["nprobe"]):
+        return None
+    if cand.backend == "ivf-sharded" and cand.shards != ref["shards"]:
+        return None
+    return f"{cand.backend}/l2/float32/serve"
+
+
+def _resident_bytes(cand: Candidate, wl: Workload) -> int:
+    """Per-device resident store bytes: what the index occupies in HBM
+    before any batch runs (the serve executable's corpus-side args)."""
+    if cand.backend in ("serial", "ring"):
+        ring_n = cand.shards or 1
+        c_tile = min(2048, _pad(wl.m, 8))
+        m_pad = _pad(math.ceil(wl.m / ring_n), c_tile)
+        # rows + squared norms + global ids (serve/index.py tile stacks)
+        return m_pad * (wl.d * 4 + 4 + 4)
+    cap = bucket_cap_for(wl.m, cand.partitions, cand.bucket_headroom)
+    shards = cand.shards or 1
+    p_local = math.ceil(cand.partitions / shards)
+    row = wl.d * _STORE_BYTES[cand.dtype] + 4 + 4  # codes + sq + id
+    if cand.dtype in ("int8", "int4"):
+        row += 4  # per-row dequant scale (ops/quant.py)
+    # centroids are replicated on every shard (ivf/sharded.py)
+    return int(p_local * cap * row) + cand.partitions * wl.d * 4
+
+
+def _exec_meta(cand: Candidate, wl: Workload) -> dict:
+    """The R2/R7 budget facts of the planned serve executable — the same
+    dict shape ``analysis.memory.temp_budget_bytes`` prices lint cells
+    with (shared code path for the temp allowance)."""
+    q_tile = min(wl.bucket, 1024)
+    if cand.backend in ("serial", "ring"):
+        c_tile = min(2048, _pad(wl.m, 8))
+        return {"q_tile": q_tile, "c_tile": c_tile, "acc_bytes": 4}
+    cap = bucket_cap_for(wl.m, cand.partitions, cand.bucket_headroom)
+    v = cand.nprobe * cap  # the probed width (R2-strict's bound)
+    # the probed-rows gather q·nprobe·cap·d is the dominant temp of a
+    # clustered serve executable (R2-strict's per-row working set,
+    # ivf/sharded.py) — the budget must carry the row dimension, not
+    # just the (q, v) distance tile
+    return {"q_tile": q_tile, "c_tile": v, "acc_bytes": 4,
+            "budget_elems": q_tile * v * wl.d}
+
+
+def predict_peak_hbm(cand: Candidate, wl: Workload,
+                     ledger_path=DEFAULT_PLAN_LEDGER) -> dict:
+    """Per-device predicted peak HBM. In-matrix configs read the
+    committed R7 ledger byte-for-byte; off-matrix shapes use R7's own
+    budget decomposition (args at face value + unaliased outputs + the
+    slack-bounded temp allowance) — conservative on purpose, so the
+    measured ``memory_analysis()`` peak of a booted deployment lands at
+    or under it."""
+    label = _matrix_label(cand, wl)
+    if label is not None:
+        committed = _memory.load_ledger(ledger_path)
+        if committed is not None and label in committed["cells"]:
+            return {
+                "peak_hbm_bytes": int(
+                    committed["cells"][label]["peak_bytes"]
+                ),
+                "source": f"ledger:{label}",
+            }
+    args = _resident_bytes(cand, wl) + wl.bucket * wl.d * 4
+    out = wl.bucket * wl.k * (4 + 4)  # (dists f32, ids s32)
+    temps = _memory.temp_budget_bytes(_exec_meta(cand, wl))
+    return {"peak_hbm_bytes": int(args + out + temps), "source": "model"}
+
+
+def _wire_bytes(cand: Candidate, wl: Workload) -> int:
+    """Per-batch interconnect bytes (the R4 wire-pricing convention:
+    payload at the wire dtype). Mirrors ``backends.ring.
+    ring_wire_bytes_per_batch`` (uni schedule) and the sharded
+    exchange's safe-route-cap sizing (``ivf/sharded.py``) without
+    importing jax."""
+    if cand.backend == "ring" and (cand.shards or 1) > 1:
+        ring_n = cand.shards
+        b = _pad(math.ceil(wl.m / ring_n), 8)
+        block = b * (wl.d * 4 + 4)  # rows + the s32 id row
+        return (ring_n - 1) * ring_n * block
+    if cand.backend == "ivf-sharded":
+        cap = bucket_cap_for(wl.m, cand.partitions, cand.bucket_headroom)
+        q_tile = min(wl.bucket, 1024)
+        qt = max(1, _pad(wl.bucket, q_tile) // q_tile)
+        route_cap = q_tile * cand.nprobe  # the safe cap (no drops)
+        row = wl.d * _STORE_BYTES[cand.dtype] + 4 + 4
+        if cand.dtype in ("int8", "int4"):
+            row += 4
+        return int(qt * cand.shards * route_cap * cap * row)
+    return 0
+
+
+def _cost_facts(cand: Candidate, wl: Workload) -> dict:
+    """R8's closed-form FLOP facts for the planned per-batch program —
+    the same schemes ``analysis.cost.analytical_mxu_flops`` certifies
+    against after-opt HLO on every matrix cell."""
+    if cand.backend in ("serial", "ring"):
+        ring_n = cand.shards or 1
+        c_tile = min(2048, _pad(wl.m, 8))
+        c_pad = _pad(math.ceil(wl.m / ring_n), c_tile)
+        return {"scheme": "dense", "q": wl.bucket, "c": c_pad,
+                "d": wl.d, "sites": 1, "trips": ring_n,
+                "queries": wl.bucket}
+    cap = bucket_cap_for(wl.m, cand.partitions, cand.bucket_headroom)
+    shards = cand.shards or 1
+    return {"scheme": "ivf", "q": max(1, wl.bucket // shards),
+            "d": wl.d, "partitions": cand.partitions,
+            "nprobe": cand.nprobe, "bucket_cap": cap,
+            "queries": wl.bucket}
+
+
+def _hbm_traffic(cand: Candidate, wl: Workload) -> int:
+    """Per-device HBM bytes one batch moves — the roofline's memory
+    leg. Dense backends stream the resident store past every query
+    tile; clustered backends score the centroid table per tile and
+    gather each query's probed buckets."""
+    q_tile = min(wl.bucket, 1024)
+    qtiles = max(1, _pad(wl.bucket, q_tile) // q_tile)
+    io = wl.bucket * wl.d * 4 + wl.bucket * wl.k * 8
+    if cand.backend in ("serial", "ring"):
+        return qtiles * _resident_bytes(cand, wl) + io
+    cap = bucket_cap_for(wl.m, cand.partitions, cand.bucket_headroom)
+    shards = cand.shards or 1
+    q_local = max(1, wl.bucket // shards)
+    row = wl.d * _STORE_BYTES[cand.dtype] + 4 + 4
+    gather = q_local * cand.nprobe * cap * row
+    cents = qtiles * cand.partitions * wl.d * 4
+    return int(cents + gather + io)
+
+
+def predict_qps(cand: Candidate, wl: Workload, profile: dict) -> dict:
+    """Roofline q/s of the planned config under one device profile —
+    the shared ``analysis.cost.roofline`` over the shared closed-form
+    FLOPs."""
+    flops = _cost.analytical_mxu_flops(_cost_facts(cand, wl))
+    hbm = _hbm_traffic(cand, wl)
+    ici = _wire_bytes(cand, wl)
+    roof = _cost.roofline(flops, hbm, ici, wl.bucket, profile)
+    return {"mxu_flops": int(flops), "hbm_bytes": int(hbm),
+            "wire_bytes": int(ici), **roof}
+
+
+# ---------------------------------------------------------------------------
+# the search
+
+
+def _candidates(wl: Workload, fleet: Fleet, backends, dtypes,
+                bucket_headroom: float):
+    """Deterministic candidate enumeration. Dense candidates are
+    float32/exact (the recall-1.0 anchors); clustered candidates sweep
+    power-of-two partition counts around √m across the permitted
+    at-rest dtypes."""
+    if fleet.devices == 1:
+        dense = ["serial"] if "serial" in backends else []
+        clustered = ["ivf"] if "ivf" in backends else []
+        shards = None
+    else:
+        dense = ["ring"] if "ring" in backends else []
+        clustered = ["ivf-sharded"] if "ivf-sharded" in backends else []
+        shards = fleet.devices
+    for b in dense:
+        if "float32" in dtypes:
+            yield Candidate(backend=b, shards=shards,
+                            bucket_headroom=bucket_headroom)
+    parts = []
+    p = 8
+    while p <= max(8, wl.m // 8):
+        parts.append(p)
+        p *= 2
+    root = math.sqrt(wl.m)
+    parts = [p for p in parts if root / 8 <= p <= root * 8] or parts[:1]
+    for b in clustered:
+        for dt in PLAN_DTYPES:
+            if dt not in dtypes:
+                continue
+            for p in parts:
+                if shards is not None and p < shards:
+                    continue
+                yield Candidate(backend=b, dtype=dt, partitions=p,
+                                shards=shards,
+                                bucket_headroom=bucket_headroom)
+
+
+def _min_nprobe(cand: Candidate, wl: Workload, calib: dict):
+    """Smallest nprobe reaching the recall target (recall is monotone
+    in probe fraction), or None when even the degenerate exact scan
+    (nprobe == partitions) misses it — the dtype cap is then the
+    ceiling the refusal names."""
+    for n in range(1, cand.partitions + 1):
+        if predict_recall(
+            n / cand.partitions, cand.dtype, calib
+        ) >= wl.recall_target:
+            return n
+    return None
+
+
+def plan(wl: Workload, fleet: Fleet, *, backends=PLAN_BACKENDS,
+         dtypes=PLAN_DTYPES, bucket_headroom: float = 0.0,
+         calib: dict | None = None,
+         ledger_path=DEFAULT_PLAN_LEDGER) -> dict:
+    """Search the configuration space and return the best feasible plan
+    (highest roofline q/s; ties break toward the leaner store). Raises
+    :class:`Infeasible` with the named binding constraint otherwise."""
+    calib = calib if calib is not None else load_calibration()
+    prof = fleet.resolved()
+    feasible = []
+    rejected = {"recall": 0, "hbm": 0, "qps": 0}
+    # the furthest-failing candidate names the binding constraint; among
+    # same-stage failures the BEST one (highest recall ceiling, smallest
+    # layout, highest roofline) makes the refusal honest: "even this
+    # config misses". (stage, score, candidate json, constraint, detail)
+    closest = None
+    STAGE = {"recall": 0, "hbm": 1, "qps": 2}
+
+    def reject(constraint, cand_doc, detail, score=0.0):
+        nonlocal closest
+        rejected[constraint] += 1
+        key = (STAGE[constraint], score)
+        if closest is None or key > (closest[0], closest[1]):
+            closest = (*key, cand_doc, constraint, detail)
+
+    for cand in _candidates(wl, fleet, backends, dtypes,
+                            bucket_headroom):
+        doc = dataclasses.asdict(cand)
+        # -- recall ----------------------------------------------------
+        if cand.backend in ("serial", "ring"):
+            recall = 1.0
+            if wl.recall_target > 1.0:
+                reject("recall", doc,
+                       f"recall target {wl.recall_target} exceeds 1.0")
+                continue
+        else:
+            n = _min_nprobe(cand, wl, calib)
+            if n is None:
+                ceiling = predict_recall(1.0, cand.dtype, calib)
+                reject(
+                    "recall", doc,
+                    f"recall target {wl.recall_target} unreachable at "
+                    f"max nprobe: even the exact nprobe=partitions="
+                    f"{cand.partitions} scan predicts "
+                    f"{ceiling:.4f} on a {cand.dtype} store (the "
+                    "measured quantization cap, "
+                    "measurements/bench_ops.json)",
+                    score=ceiling,
+                )
+                continue
+            cand = dataclasses.replace(cand, nprobe=n)
+            doc = dataclasses.asdict(cand)
+            recall = predict_recall(n / cand.partitions, cand.dtype,
+                                    calib)
+        # -- hbm -------------------------------------------------------
+        peak = predict_peak_hbm(cand, wl, ledger_path=ledger_path)
+        if peak["peak_hbm_bytes"] > prof["budget_bytes"]:
+            reject(
+                "hbm", doc,
+                f"predicted peak HBM {peak['peak_hbm_bytes']} B/device "
+                f"exceeds the budget {prof['budget_bytes']} B "
+                f"({fleet.devices} × {prof['hbm_bytes']} B at "
+                f"{fleet.hbm_headroom:.0%} headroom) — resident store "
+                f"{_resident_bytes(cand, wl)} B dominates",
+                score=-peak["peak_hbm_bytes"],
+            )
+            continue
+        # -- qps -------------------------------------------------------
+        perf = predict_qps(cand, wl, prof)
+        if wl.qps and perf["qps"] < wl.qps:
+            reject(
+                "qps", doc,
+                f"offered {wl.qps:.0f} q/s exceeds the roofline "
+                f"{perf['qps']:.0f} q/s (bound: {perf['bound']} leg "
+                f"of profile {fleet.profile!r})",
+                score=perf["qps"],
+            )
+            continue
+        feasible.append((cand, recall, peak, perf))
+
+    if not feasible:
+        _, _, cand_doc, constraint, detail = closest
+        raise Infeasible(constraint, detail, cand_doc, rejected)
+
+    cand, recall, peak, perf = max(
+        feasible,
+        key=lambda f: (f[3]["qps"], -_resident_bytes(f[0], wl)),
+    )
+    return {
+        "feasible": True,
+        "workload": wl.to_json(),
+        "fleet": {**fleet.to_json(), "profile_facts": prof},
+        "config": dataclasses.asdict(cand),
+        "predicted": {
+            "recall_at_k": round(recall, 4),
+            "peak_hbm_bytes": peak["peak_hbm_bytes"],
+            "peak_hbm_source": peak["source"],
+            "wire_bytes_per_batch": perf["wire_bytes"],
+            "mxu_flops_per_batch": perf["mxu_flops"],
+            "hbm_bytes_per_batch": perf["hbm_bytes"],
+            "qps": round(perf["qps"], 1),
+            "wall_s_per_batch": perf["wall_s"],
+            "roofline_bound": perf["bound"],
+        },
+        "rejected": rejected,
+        "commands": _commands(cand, wl, fleet),
+    }
+
+
+# ---------------------------------------------------------------------------
+# command emission
+
+
+def _commands(cand: Candidate, wl: Workload, fleet: Fleet,
+              data: str | None = None,
+              index_out: str = "plan.ivf.npz") -> dict:
+    """The exact commands that deploy this plan. Quantized at-rest
+    stores serve through ``mpi-knn query --index-load`` (the serving
+    engine CLI owns the dequant path); float stores boot the HTTP front
+    end directly."""
+    data = data or f"synthetic:{wl.m}x{wl.d}"
+    out = {}
+    serve = [
+        "mpi-knn", "serve", "--data", data, "--k", str(wl.k),
+        "--bucket", str(wl.bucket),
+    ]
+    if cand.backend in ("serial", "ring"):
+        serve += ["--backend",
+                  "serial" if cand.backend == "serial" else "ring"]
+        if cand.backend == "ring":
+            serve += ["--devices", str(fleet.devices)]
+        out["serve"] = " ".join(serve)
+        return out
+    build = [
+        "mpi-knn", "build-index", "--data", data,
+        "--partitions", str(cand.partitions),
+        "--nprobe", str(cand.nprobe),
+        "--dtype", cand.dtype, "--k", str(wl.k),
+        "--out", index_out,
+    ]
+    if cand.backend == "ivf-sharded":
+        build += ["--backend", "ring"]
+    out["build_index"] = " ".join(build)
+    if cand.dtype in ("float32", "bfloat16"):
+        serve += ["--partitions", str(cand.partitions),
+                  "--nprobe", str(cand.nprobe)]
+        if cand.dtype != "float32":
+            serve += ["--dtype", cand.dtype]
+        if cand.bucket_headroom:
+            serve += ["--bucket-headroom", str(cand.bucket_headroom)]
+        if cand.backend == "ivf-sharded":
+            serve += ["--backend", "ring", "--devices",
+                      str(fleet.devices)]
+        out["serve"] = " ".join(serve)
+    else:
+        query = [
+            "mpi-knn", "query", "--data", data,
+            "--index-load", index_out, "--k", str(wl.k),
+            "--bucket", str(wl.bucket),
+        ]
+        if cand.backend == "ivf-sharded":
+            query += ["--backend", "ring", "--devices",
+                      str(fleet.devices)]
+        out["serve"] = " ".join(query)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn plan",
+        description="ledger-driven capacity planner: solve for a "
+        "serving configuration from corpus shape, recall target, "
+        "offered QPS, and fleet; exit 2 + structured refusal naming "
+        "the binding constraint (recall/hbm/qps) when infeasible",
+    )
+    w = p.add_argument_group("workload")
+    w.add_argument("--corpus", type=int, required=True, metavar="M",
+                   help="corpus rows")
+    w.add_argument("--dim", type=int, required=True, metavar="D",
+                   help="corpus dimensionality")
+    w.add_argument("--k", type=int, default=10)
+    w.add_argument("--recall-target", type=float, default=0.95,
+                   help="predicted recall@k the plan must reach "
+                   "(calibrated from measurements/bench_ops.json)")
+    w.add_argument("--qps", type=float, default=0.0,
+                   help="offered queries/s the roofline must sustain "
+                   "(0 = no throughput constraint)")
+    w.add_argument("--bucket", type=int, default=1024,
+                   help="serve row bucket (the roofline's batch size)")
+    f = p.add_argument_group("fleet")
+    f.add_argument("--devices", type=int, default=1)
+    f.add_argument("--device-profile", default=_cost.DEFAULT_PROFILE,
+                   help="declared device profile "
+                   "(analysis/device_profiles.json: cpu-test, tpu-v4, "
+                   "tpu-v5e)")
+    f.add_argument("--hbm-bytes", type=int, default=None,
+                   help="per-device HBM capacity override (default: "
+                   "the profile's)")
+    f.add_argument("--hbm-headroom", type=float, default=0.1,
+                   help="HBM fraction kept free per device")
+    s = p.add_argument_group("search space")
+    s.add_argument("--backend", action="append", choices=PLAN_BACKENDS,
+                   help="restrict the searched backends; repeatable")
+    s.add_argument("--dtype", action="append", choices=PLAN_DTYPES,
+                   help="restrict the searched at-rest dtypes; "
+                   "repeatable (forcing int4 is how a recall refusal "
+                   "becomes reachable)")
+    s.add_argument("--bucket-headroom", type=float, default=0.0,
+                   help="mutation headroom built into the planned "
+                   "bucket_cap")
+    o = p.add_argument_group("output")
+    o.add_argument("--data", default=None,
+                   help="corpus spec to embed in the emitted commands "
+                   "(default: synthetic:MxD)")
+    o.add_argument("--index-out", default="plan.ivf.npz",
+                   help="index artifact path in the emitted "
+                   "build-index command")
+    o.add_argument("--bench", default=None, metavar="PATH",
+                   help="recall-calibration bench baseline (default: "
+                   "measurements/bench_ops.json)")
+    o.add_argument("--ledger", default=None, metavar="PATH",
+                   help="committed R7 memory ledger for the in-matrix "
+                   "peak lookup (default: artifacts/lint/"
+                   "memory_ledger.json)")
+    o.add_argument("-q", "--quiet", action="store_true",
+                   help="JSON only (no human summary line on stderr)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    wl = Workload(m=args.corpus, d=args.dim, k=args.k,
+                  recall_target=args.recall_target, qps=args.qps,
+                  bucket=args.bucket)
+    fleet = Fleet(devices=args.devices, profile=args.device_profile,
+                  hbm_bytes=args.hbm_bytes,
+                  hbm_headroom=args.hbm_headroom)
+    try:
+        calib = load_calibration(args.bench or DEFAULT_BENCH)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        doc = plan(
+            wl, fleet,
+            backends=tuple(args.backend or PLAN_BACKENDS),
+            dtypes=tuple(args.dtype or PLAN_DTYPES),
+            bucket_headroom=args.bucket_headroom,
+            calib=calib,
+            ledger_path=pathlib.Path(
+                args.ledger if args.ledger else DEFAULT_PLAN_LEDGER
+            ),
+        )
+    except KeyError as e:  # unknown profile
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    except Infeasible as e:
+        print(json.dumps({
+            "feasible": False,
+            "binding_constraint": e.constraint,
+            "detail": e.detail,
+            "closest_candidate": e.candidate,
+            "rejected": e.rejected,
+            "workload": wl.to_json(),
+            "fleet": fleet.to_json(),
+        }, indent=1))
+        if not args.quiet:
+            print(f"plan: INFEASIBLE — {e.constraint}: {e.detail}",
+                  file=sys.stderr)
+        return 2
+    doc["commands"] = _commands(
+        Candidate(**doc["config"]), wl, fleet,
+        data=args.data, index_out=args.index_out,
+    )
+    print(json.dumps(doc, indent=1))
+    if not args.quiet:
+        pred = doc["predicted"]
+        print(
+            f"plan: {doc['config']['backend']} "
+            f"(dtype {doc['config']['dtype']}"
+            + (f", partitions {doc['config']['partitions']}, nprobe "
+               f"{doc['config']['nprobe']}"
+               if doc["config"]["partitions"] else "")
+            + f") — recall {pred['recall_at_k']}, peak HBM "
+            f"{pred['peak_hbm_bytes']} B/device "
+            f"[{pred['peak_hbm_source']}], {pred['qps']} q/s "
+            f"({pred['roofline_bound']}-bound)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,15 @@ def _probe_child(platform: str, cache_dir: str | None = None) -> int:
     print(json.dumps({"memory": _memory_probe(probe_compiled)}),
           flush=True)
     maybe_beat("memory-done")
+    # sixth stdout line (ISSUE 16): the capacity-planner block — run
+    # `mpi_knn_tpu.plan` against the probe-discovered device facts for a
+    # tiny corpus, assert a feasible plan comes back AND its predicted
+    # peak HBM covers the probe executable's own measured
+    # memory_analysis() peak (the planner's conservative model must
+    # bound what this runtime actually allocates) — folded into ok.
+    maybe_beat("plan-probe")
+    print(json.dumps({"plan": _plan_probe(probe_compiled)}), flush=True)
+    maybe_beat("plan-done")
     return 0
 
 
@@ -151,6 +160,54 @@ def _memory_probe(compiled) -> dict:
         "predicted_peak_bytes": predicted.peak_bytes,
         "measured": measured,
         "disagreements": disagreements,
+    }
+
+
+def _plan_probe(compiled) -> dict:
+    """The doctor's capacity-planner round trip (ISSUE 16): plan a tiny
+    corpus against THIS process's discovered device facts (platform →
+    shipped profile, real device count) and hold the plan's predicted
+    peak HBM against the probe executable's measured
+    ``memory_analysis()`` peak. The probe program is deliberately tiny,
+    so any feasible plan whose prediction does NOT cover it means the
+    planner's memory model is broken on this host — hard evidence, zero
+    extra compiles."""
+    import jax
+
+    from mpi_knn_tpu import plan as planner
+    from mpi_knn_tpu.analysis.cost import (
+        DEFAULT_PROFILE,
+        profile_for_platform,
+    )
+    from mpi_knn_tpu.analysis.memory import pjrt_memory_stats
+
+    name = profile_for_platform(
+        jax.default_backend(),
+        getattr(jax.devices()[0], "device_kind", ""),
+    ) or DEFAULT_PROFILE  # off-map hardware still exercises the planner
+    wl = planner.Workload(m=4096, d=64, k=10, recall_target=0.9,
+                          qps=0.0, bucket=256)
+    fleet = planner.Fleet(devices=1, profile=name)
+    try:
+        doc = planner.plan(wl, fleet)
+    except planner.Infeasible as e:
+        return {"ok": False, "profile": name,
+                "reason": f"tiny-corpus plan infeasible — "
+                          f"{e.constraint}: {e.detail}"}
+    except (OSError, ValueError, KeyError) as e:
+        return {"ok": False, "profile": name,
+                "reason": f"planner calibration unavailable: {e}"}
+    measured = pjrt_memory_stats(compiled)
+    probe_peak = measured["peak_bytes"] if measured else None
+    predicted = doc["predicted"]["peak_hbm_bytes"]
+    covered = probe_peak is None or predicted >= probe_peak
+    return {
+        "ok": bool(covered),
+        "profile": name,
+        "config": doc["config"],
+        "predicted_peak_hbm_bytes": predicted,
+        "probe_measured_peak_bytes": probe_peak,
+        "predicted_qps": doc["predicted"]["qps"],
     }
 
 
@@ -242,6 +299,7 @@ def run_probe(
     aot_cache = None
     mutation = None
     memory = None
+    plan = None
     if res.ok:
         for line in res.stdout.splitlines():
             try:
@@ -258,6 +316,8 @@ def run_probe(
                 mutation = doc["mutation"]
             elif isinstance(doc, dict) and "memory" in doc:
                 memory = doc["memory"]
+            elif isinstance(doc, dict) and "plan" in doc:
+                plan = doc["plan"]
     return {
         # the AOT cache block (ISSUE 12): None when no cache dir is
         # configured — absent, not a fake-healthy zero row
@@ -273,10 +333,17 @@ def run_probe(
         # ledger gate would be lying on this host); None-tolerant for
         # older probe children
         "memory": memory,
+        # the capacity-planner block (ISSUE 16): a feasible tiny-corpus
+        # plan from THIS host's discovered facts, with its predicted
+        # peak HBM covering the probe executable's measured peak — an
+        # uncovered probe fails the verdict (the planner would under-
+        # promise memory on this host); None-tolerant for older children
+        "plan": plan,
         "ok": bool(
             res.ok and probe is not None
             and (mutation is None or mutation.get("ok", False))
             and (memory is None or memory.get("ok", False))
+            and (plan is None or plan.get("ok", False))
         ),
         "status": res.status if probe is not None or not res.ok
         else "crashed",  # rc 0 but no probe line = a broken child

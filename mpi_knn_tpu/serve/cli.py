@@ -575,6 +575,12 @@ def _stream_and_report(args, session, index, X, source, build_s) -> int:
         # throughput it bounds
         "peak_hbm_bytes": index_peak_hbm_bytes(index),
     }
+    from mpi_knn_tpu.analysis.cost import detected_profile
+
+    # the declared roofline inputs for this hardware (ISSUE 16): the
+    # shipped device profile `mpi-knn plan` predicted q/s under, stamped
+    # next to the measured throughput; null off the profile map
+    summary["device_profile"] = detected_profile()
     if index.backend in ("ivf", "ivf-sharded"):
         summary["partitions"] = index.partitions
         summary["nprobe"] = cfg.nprobe

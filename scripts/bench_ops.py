@@ -243,6 +243,30 @@ def main(argv=None) -> int:
         return {"peak_hbm_bytes": cell["peak_bytes"],
                 "peak_hbm_cell": cell_label}
 
+    # R8's predicted q/s (ISSUE 16, committed artifacts/lint/
+    # cost_ledger.json, regenerated by `mpi-knn lint --cost`) rides the
+    # same convention: the LINT cell's roofline under the default
+    # profile, stamped with its cell label — every bench round,
+    # including the pending TPU round, auto-reports predicted-vs-
+    # measured without new plumbing.
+    def ledger_roofline(cell_label):
+        try:
+            from mpi_knn_tpu.analysis.cost import (
+                DEFAULT_COST_LEDGER,
+                load_cost_ledger,
+            )
+
+            doc = load_cost_ledger(REPO / DEFAULT_COST_LEDGER)
+        except Exception:
+            doc = None
+        if not doc:
+            return {}
+        cell = doc["cells"].get(cell_label)
+        if cell is None:
+            return {}
+        return {"predicted_qps": round(cell["roofline"]["qps"], 1),
+                "roofline_cell": cell_label}
+
     def record(op, variant, times):
         row = {
             "op": op,
@@ -446,6 +470,7 @@ def main(argv=None) -> int:
             "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
             "queries_per_s": round(session.queries_served / wall, 1),
             **ledger_peak("serial/l2/float32/serve"),
+            **ledger_roofline("serial/l2/float32/serve"),
         }
         results.append(row)
         print(f"{'query_knn':16s} {row['variant']:16s} "
@@ -593,6 +618,7 @@ def main(argv=None) -> int:
             "recall_at_k": round(float(recall), 4),
             "probe_fraction": round(nprobe / P, 4),
             **ledger_peak("ivf/l2/float32/serve"),
+            **ledger_roofline("ivf/l2/float32/serve"),
         }
         results.append(row)
         print(f"{'ivf_query':16s} {row['variant']:16s} "
@@ -856,6 +882,7 @@ def main(argv=None) -> int:
                     "exchange_bytes_total":
                         session.exchange["exchange_bytes_total"],
                     **ledger_peak("ivf-sharded/l2/float32/serve"),
+            **ledger_roofline("ivf-sharded/l2/float32/serve"),
                 }
                 results.append(row)
                 print(f"{'ivf_sharded_query':16s} {row['variant']:20s} "

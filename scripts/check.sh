@@ -108,8 +108,15 @@ note "static lint of every backend's compiled program (mpi-knn lint)"
 # memory_analysis within the declared band) and --memory --ledger-check
 # recomputes every cell's numbers and fails on drift beyond tolerance
 # vs the committed artifacts/lint/memory_ledger.json in EITHER
-# direction (growth = regression, shrinkage = stale ledger)
-python -m mpi_knn_tpu lint -q --memory --ledger-check \
+# direction (growth = regression, shrinkage = stale ledger) — PLUS the
+# cost axis (ISSUE 16): R8-cost prices every cell (MXU FLOPs from dot
+# shapes × static execution counts, cross-checked EXACTLY against the
+# closed-form analytical count from the cell's own config; modeled HBM
+# traffic; wire-priced ICI census — an unpriced collective is a
+# finding) and --cost --ledger-check holds the numbers to the committed
+# artifacts/lint/cost_ledger.json the same way (growth = perf
+# regression naming the culprit op, shrinkage = stale ledger)
+python -m mpi_knn_tpu lint -q --memory --cost --ledger-check \
     --out artifacts/lint || fail=1
 
 note "peak-HBM memory gate (ISSUE 15: R7 liveness + the memory ledger)"
@@ -144,6 +151,94 @@ MEMEOF
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_memory_lint.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly || fail=1
+
+note "static cost gate (ISSUE 16: R8 roofline + the cost ledger)"
+# the sweep above just re-priced every cell and held it to the committed
+# cost ledger (--cost --ledger-check, drift green by exit code). The
+# named assertions prove the committed artifact is complete and honest:
+# every checked cell has a ledger entry, every entry's HLO-derived FLOP
+# count EQUALS the closed-form analytical count (the R8 exactness
+# contract — not within tolerance, equal), and every roofline names its
+# binding resource. The injected counterexamples (a doctored dot the
+# analytical form cannot name, an unpriced collective, ledger drift both
+# directions through the real CLI) and the planner refusal matrix fire
+# in tests/test_cost_plan.py below.
+python - <<'COSTEOF' || fail=1
+import json
+ledger = json.load(open("artifacts/lint/cost_ledger.json"))
+report = json.load(open("artifacts/lint/report.json"))
+cells = ledger["cells"]
+checked = [t for t in report["targets"] if t["skipped"] is None]
+missing = [t["label"] for t in checked if t["label"] not in cells]
+assert not missing, f"checked cells missing from the cost ledger: {missing}"
+for label, cell in cells.items():
+    assert cell["mxu_flops"] == cell["analytical_flops"], (
+        f"{label}: HLO flops {cell['mxu_flops']} != analytical "
+        f"{cell['analytical_flops']}")
+    assert cell["roofline"]["bound"] in ("mxu", "hbm", "ici"), (
+        f"{label}: roofline names no binding resource")
+print(f"cost gate: {len(cells)} ledger cells, HLO == analytical FLOPs "
+      f"on every cell (tolerance {ledger['tolerance']} for drift only)")
+COSTEOF
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_cost_plan.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || fail=1
+
+note "capacity-planner boot gate (ISSUE 16: mpi-knn plan round trip)"
+# `mpi-knn plan` solves a small corpus, then the gate BOOTS the exact
+# serve command the planner emitted and holds the deployment to the
+# promise: /healthz peak_hbm_bytes (the measured PJRT peak of the
+# largest built executable) must be ≤ the plan's predicted peak — the
+# planner may over-reserve, never under-promise. Refusal exit codes and
+# the in-matrix ledger byte-equality are tier-1 (tests/test_cost_plan.py).
+PLAN_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP" "$PLAN_TMP"' EXIT
+python -m mpi_knn_tpu plan --corpus 2048 --dim 32 --bucket 128 \
+    --recall-target 0.9 --dtype float32 -q \
+    > "$PLAN_TMP/plan.json" || fail=1
+PLAN_SERVE="$(python -c "import json; print(json.load(open(
+    '$PLAN_TMP/plan.json'))['commands']['serve'].replace('mpi-knn ', '', 1))")"
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu \
+    $PLAN_SERVE --port 0 --ready-file "$PLAN_TMP/ready" -q &
+PLAN_PID=$!
+plan_ok=0
+for _ in $(seq 1 120); do
+    [ -s "$PLAN_TMP/ready" ] && { plan_ok=1; break; }
+    kill -0 "$PLAN_PID" 2>/dev/null || break
+    sleep 1
+done
+if [ "$plan_ok" = 1 ]; then
+    timeout -k 10 180 python - "$(cat "$PLAN_TMP/ready")" \
+        "$PLAN_TMP/plan.json" <<'PLANEOF' || fail=1
+import json, sys, time, urllib.request
+url, plan_path = sys.argv[1], sys.argv[2]
+for _ in range(150):
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        h = json.load(r)
+    if h["warming"]["done"]:
+        break
+    time.sleep(1)
+else:
+    raise AssertionError("serve never finished warming")
+plan = json.load(open(plan_path))
+pred = plan["predicted"]["peak_hbm_bytes"]
+measured = h["peak_hbm_bytes"]
+assert measured > 0, "booted serve reports no measured peak"
+assert measured <= pred, (
+    f"planner under-promised: measured peak {measured}B > "
+    f"predicted {pred}B for {plan['config']}")
+assert h.get("device_profile"), "/healthz carries no device profile"
+print(f"plan boot gate: {plan['config']['backend']} plan booted, "
+      f"measured peak {measured}B <= predicted {pred}B "
+      f"(profile {h['device_profile']['name']})")
+PLANEOF
+    kill -TERM "$PLAN_PID" 2>/dev/null
+    wait "$PLAN_PID" || fail=1
+else
+    echo "plan boot gate: planner-emitted serve failed to come up"
+    kill "$PLAN_PID" 2>/dev/null
+    fail=1
+fi
 
 note "sharded-IVF lint gate (ISSUE 8: routed candidate exchange)"
 # the sharded clustered cells by name (they also run inside the full
@@ -232,7 +327,7 @@ note "serving front end gate (ISSUE 11: mpi-knn serve + loadgen)"
 # runs inside the full `mpi-knn lint` sweep above; `--frontend` selects
 # it alone.
 FE_TMP="$(mktemp -d)"
-trap 'rm -rf "$OBS_TMP" "$FE_TMP"' EXIT
+trap 'rm -rf "$OBS_TMP" "$PLAN_TMP" "$FE_TMP"' EXIT
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu serve \
     --data synthetic:2048x32c4 --k 10 --backend serial --bucket 128 \
     --corpus-tile 512 --port 0 --ready-file "$FE_TMP/ready" \
@@ -303,7 +398,7 @@ note "live-mutation gate (ISSUE 14: serve + HTTP upsert/delete/query)"
 # mutation programs is the lint matrix above (mutate-* cells); the
 # correctness matrix is tier-1 (tests/test_mutation.py).
 MUT_TMP="$(mktemp -d)"
-trap 'rm -rf "$OBS_TMP" "$FE_TMP" "$MUT_TMP"' EXIT
+trap 'rm -rf "$OBS_TMP" "$PLAN_TMP" "$FE_TMP" "$MUT_TMP"' EXIT
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu serve \
     --data synthetic:2048x32c8 --k 10 --partitions 16 --nprobe 4 \
     --bucket 128 --bucket-headroom 0.5 --mutation-bucket 64 \

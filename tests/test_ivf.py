@@ -493,7 +493,7 @@ def test_sift32k_recall_target_with_sublinear_probed_bytes():
     serve_cfg = idx.compatible_cfg(idx.cfg)
     lowered, q_pad, q_tile = lower_bucket(idx, serve_cfg, 256)
     meta = {
-        **_ivf_meta(idx, serve_cfg, q_tile),
+        **_ivf_meta(idx, serve_cfg, q_tile, q_pad, 256),
         "serve": True,
         "donated_params": SCRATCH_PARAMS,
         "resident_bytes": idx.nbytes_resident,

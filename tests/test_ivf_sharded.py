@@ -757,7 +757,7 @@ def test_sift32k_sharded_acceptance(compile_counter):
         sidx.shards,
     )
     meta = {
-        **_ivf_sharded_meta(sidx, serve_cfg, q_tile, route_cap),
+        **_ivf_sharded_meta(sidx, serve_cfg, q_tile, route_cap, q_pad, 256),
         "serve": True,
         "donated_params": SHARDED_SCRATCH_PARAMS,
         "resident_bytes": sidx.nbytes_resident,

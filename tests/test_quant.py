@@ -478,7 +478,7 @@ def test_sift32k_int4_acceptance_gate():
     lowered, q_pad, q_tile = lower_bucket(idx, serve_cfg, 256)
     target = LintTarget("ivf", "l2", "float32", serve=True, quant="int4")
     meta = {
-        **_ivf_meta(idx, serve_cfg, q_tile),
+        **_ivf_meta(idx, serve_cfg, q_tile, q_pad, 256),
         "serve": True,
         "donated_params": SCRATCH_PARAMS,
         # the f32-EQUIVALENT copy threshold: a quantized store's own
