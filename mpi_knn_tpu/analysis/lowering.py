@@ -96,6 +96,14 @@ class LintTarget:
     # scatter/dynamic-update-slice forms exempted as buffer-forwarding
     # plumbing (meta strict_exempt_ops)
     mutate: str = ""
+    # fusion="fused" (ring backends only): the per-round compute is the
+    # fused collective-matmul Pallas kernel (ops/pallas_ring.py) instead
+    # of the XLA tile pipeline. On the CPU lint platform the kernel runs
+    # in interpret mode with driver-owned ppermute transport, so R1/R4's
+    # permute accounting still sees the rotation; the kernel-owned-DMA
+    # form (TPU, uni/exact) is covered by the meta side-band contract
+    # (fused_dma / fused_dma_wire_bytes) that R1/R4/R8 branch on
+    fusion: str = "xla"
 
     @property
     def label(self) -> str:
@@ -104,6 +112,8 @@ class LintTarget:
             base = f"{base}/{self.policy}"
         if self.schedule != "uni":
             base = f"{base}/{self.schedule}"
+        if self.fusion != "xla":
+            base = f"{base}/{self.fusion}"
         if self.quant:
             base = f"{base}/{self.quant}"
         if self.serve:
@@ -254,6 +264,28 @@ def default_targets() -> list[LintTarget]:
         LintTarget("ring-overlap", "l2", "float32", "mixed", serve=True,
                    quant="xfer-int8"),
     ] + [
+        # the FUSED collective-matmul rotation (ops/pallas_ring.py): the
+        # per-round compute is the Pallas merge kernel; on this CPU lint
+        # platform it lowers in interpret mode with the driver's
+        # ppermutes still moving the wire bytes, so R1's overlap
+        # sequencing, R4's permute count/direction/payload accounting,
+        # R3's dequant contract (int8 wire dequantizes inside the
+        # kernel) and R8's FLOP-exactness contract all re-certify on the
+        # fused form with no special-casing; R7 additionally prices the
+        # declared double-buffer landing residency (extra_elems). The
+        # kernel-owned-DMA TPU form (zero permutes, wire bytes declared
+        # via the fused_dma side-band) is certified by the injected-meta
+        # tests — it cannot lower off-TPU.
+        LintTarget("ring-overlap", "l2", "float32", fusion="fused"),
+        LintTarget("ring-overlap", "l2", "float32", "exact", "bidir",
+                   fusion="fused"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed",
+                   fusion="fused"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed", "bidir",
+                   fusion="fused"),
+        LintTarget("ring-overlap", "l2", "float32", "mixed",
+                   quant="xfer-int8", fusion="fused"),
+    ] + [
         # clustered at-rest int8/int4: R2-strict keeps the element budget
         # AND adds the wire-priced gather bound (the probe gather must
         # move code lanes, 4–8× under the f32 bytes — dequantize AFTER
@@ -295,6 +327,7 @@ def _base_cfg(target: LintTarget) -> KNNConfig:
         ring_transfer_dtype=(
             "int8" if target.quant == "xfer-int8" else None
         ),
+        ring_fusion=target.fusion,
     )
 
 
@@ -530,6 +563,46 @@ def _lower_ring(target: LintTarget):
         meta["extra_elems"] = max(
             meta.get("extra_elems", 0), 2 * block_elems
         )
+    if target.fusion == "fused":
+        from mpi_knn_tpu.backends.ring import ring_wire_bytes_per_batch
+
+        block_elems = (c_pad // ring_n) * LINT_D
+        # Which side owns the wire this cell? Same predicate as the
+        # runtime dispatch in backends/ring.py: only the TPU round form
+        # (uni + exact) moves the block with in-kernel async remote DMAs;
+        # everywhere else (including this CPU lint platform) the driver's
+        # ppermutes carry identical bytes and the permute census above
+        # stays in force unchanged.
+        fused_dma = (
+            target.schedule == "uni"
+            and target.policy == "exact"
+            and cfg.ring_fused_rotation == "round"
+            and jax.default_backend() == "tpu"
+        )
+        meta["fused_dma"] = fused_dma
+        # R7: the fused kernel double-buffers the incoming block — the
+        # landing buffer for round r+1 is resident while round r's block
+        # is on the MXU, so two wire blocks (+ their id rows, folded into
+        # the slack) live per device beyond the xla form's single
+        # traveler. Declared, not ridden on the input floor (the bidir
+        # allowance's rationale).
+        meta["extra_elems"] = max(
+            meta.get("extra_elems", 0), 2 * block_elems
+        )
+        if fused_dma:
+            # kernel-owned transport: the lowered program contains ZERO
+            # collective-permutes — the rotation is async remote copies
+            # issued inside the kernel, invisible to both R4's permute
+            # census and R8's collective census. The side-band declares
+            # the per-device wire bytes of one full rotation so R8
+            # prices the fused cell instead of silently reporting zero
+            # ICI; a fused_dma cell WITHOUT this declaration is the
+            # unpriced-fused-DMA finding.
+            meta["expected_permutes"] = 0
+            meta["fused_dma_wire_bytes"] = (
+                ring_wire_bytes_per_batch(cfg, c_pad, LINT_D, ring_n)
+                // ring_n
+            )
     return lowered, cfg, meta
 
 

@@ -82,6 +82,11 @@ from mpi_knn_tpu.backends.serial import (
     cap_corpus_tile,
     merge_tiles_into_carry,
 )
+from mpi_knn_tpu.ops.pallas_ring import (
+    fused_block_merge,
+    fused_rotation_grid,
+    fused_round_dma,
+)
 from mpi_knn_tpu.parallel.mesh import make_ring_mesh
 from mpi_knn_tpu.parallel.partition import (
     make_global_ids,
@@ -117,6 +122,23 @@ def blocking_undefined_on_mesh_error(mesh_axes) -> ValueError:
         "the requested compute-then-send sequencing would silently run as "
         "the overlap schedule. The 1-D ring is the only defined blocking "
         "A/B object — use backend='ring-overlap' with --dp, or drop --dp."
+    )
+
+
+def fused_blocking_undefined_error() -> ValueError:
+    """The one wording for the fused-rotation × blocking-schedule hard
+    error, shared by the ring drivers (same pattern as
+    :func:`blocking_undefined_on_mesh_error`): the fused form streams the
+    next block DURING the distance sweep by construction — on TPU the
+    kernel itself owns the DMA — so a 'blocking' fused run would either be
+    a contradiction (TPU) or a silent mislabel (interpret). Refuse."""
+    return ValueError(
+        "ring_fusion='fused' is undefined under the blocking schedule "
+        "(backend='ring' / overlap=False): the fused kernel streams the "
+        "next block over ICI while the current one is on the MXU — there "
+        "is no compute-then-send sequencing to certify. Use "
+        "backend='ring-overlap', or ring_fusion='xla' for the blocking "
+        "A/B baseline."
     )
 
 
@@ -173,6 +195,26 @@ def _ring_knn_local(
     num_dev = axis_size(axis)
     bidir = cfg.ring_schedule == "bidir"
     quantized = cfg.ring_transfer_dtype == "int8"
+    fused = cfg.ring_fusion == "fused"
+    if fused and not overlap:
+        raise fused_blocking_undefined_error()
+    # The fused form's transport escalation ladder: the fused Pallas kernel
+    # always owns the per-round COMPUTE (tile distances + carry merge, bit-
+    # identical to the XLA form by construction — ops/pallas_ring.py); who
+    # owns the TRANSPORT depends on where we run. On TPU with the uni/exact
+    # round form the kernel issues the remote DMAs itself
+    # (fused_round_dma) — the collective-matmul shape. Bidir and the mixed
+    # compress round keep transport at the driver's ppermutes until their
+    # DMA forms are banked on hardware; off-TPU (interpret mode) transport
+    # is ALWAYS the driver's ppermute moving the identical wire bytes,
+    # which is what makes the CPU parity matrix a real certificate.
+    fused_dma = (
+        fused
+        and not bidir
+        and cfg.precision_policy == "exact"
+        and cfg.ring_fused_rotation == "round"
+        and jax.default_backend() == "tpu"
+    )
     # send to the next rank, wrap at the end — the reference's ring direction
     # (rank -> rank+1, mpi-knn-parallel_blocking.c:131); bidir adds the
     # counter-rotating permute so both ICI link directions carry a block
@@ -232,6 +274,25 @@ def _ring_knn_local(
 
     def compute(blk, blk_ids, blk_scl, cd, ci):
         """Tiled (q_local × b) step: all query tiles against all block tiles."""
+        if fused:
+            # the fused Pallas kernel replaces the whole per-round merge —
+            # dequant/upcast, masked tile distances and the carry top-k all
+            # happen in-kernel on flat (q_local, k) carries (per-row
+            # independence makes the (QT, q_tile) carry blocking a pure
+            # layout choice, so reshaping through it is bit-free)
+            fd, fi = fused_block_merge(
+                queries,
+                query_ids,
+                blk,
+                blk_ids,
+                blk_scl,
+                cd.reshape(q_local, cfg.k),
+                ci.reshape(q_local, cfg.k),
+                cfg=cfg,
+                q_tile=q_tile,
+                c_tile=c_tile,
+            )
+            return fd.reshape(cd.shape), fi.reshape(ci.shape)
         if blk_scl is not None:
             # the int8 dequant: ONE convert out of the code domain and ONE
             # multiply by the block's scale vector, feeding every distance
@@ -263,6 +324,28 @@ def _ring_knn_local(
 
     def step(state, _):
         blk, scl, blk_ids, cd, ci = state
+        if fused_dma:
+            # collective-matmul round: ONE kernel issues the async remote
+            # copies of the resident block and runs the distance sweep —
+            # the landing buffers it returns are the next round's resident
+            # block, so transport never appears as a separate HLO op
+            nxt, nscl, nxt_ids, fd, fi = fused_round_dma(
+                queries,
+                query_ids,
+                blk,
+                blk_ids,
+                scl,
+                cd.reshape(q_local, cfg.k),
+                ci.reshape(q_local, cfg.k),
+                cfg=cfg,
+                q_tile=q_tile,
+                c_tile=c_tile,
+                axis_name=axis,
+            )
+            return (
+                nxt, nscl, nxt_ids,
+                fd.reshape(cd.shape), fi.reshape(ci.shape),
+            ), None
         if overlap:
             # permute and compute both depend only on the incoming block —
             # XLA overlaps the ICI transfer with the distance matmul (the
@@ -342,6 +425,33 @@ def _ring_knn_local(
             nbs = _rot(bscl, perm_bwd)
             nbi = jax.lax.ppermute(bids, axis, perm_bwd)
         return (nfb, nfs, nfi, nbb, nbs, nbi, cd, ci), None
+
+    if fused and cfg.ring_fused_rotation == "grid":
+        if single_round:
+            raise ValueError(
+                "ring_fused_rotation='grid' runs the whole rotation as ONE "
+                "kernel launch — there is no per-round boundary for the "
+                "resumable driver to checkpoint at; use "
+                "ring_fused_rotation='round' with backend='ring-resumable'"
+            )
+        # whole-rotation form: rounds ride the kernel's major grid axis,
+        # the block double-buffers between two HBM scratch slots
+        # (TPU-only; fused_rotation_grid raises off-TPU — config already
+        # pinned this variant to the uni schedule and exact policy)
+        out_d, out_i = fused_rotation_grid(
+            queries,
+            query_ids,
+            block,
+            block_ids,
+            carry_d.reshape(q_local, cfg.k),
+            carry_i.reshape(q_local, cfg.k),
+            cfg=cfg,
+            q_tile=q_tile,
+            c_tile=c_tile,
+            axis_name=axis,
+            num_dev=num_dev,
+        )
+        return out_d, out_i
 
     if single_round:
         if bidir:

@@ -304,9 +304,14 @@ def all_knn_ring_resumable(
     # bidir carry means "the two-cursor prefix merged" — the same
     # rounds_done under the other schedule would silently skip/duplicate
     # blocks, so the two must never cross-resume.
+    # ring_fusion rides the suffix for the same reason as the schedule:
+    # fused and xla carries are bit-identical BY TEST, not by contract —
+    # if a future kernel revision legitimately changes merge bits, a
+    # cross-fusion resume must restart rather than mix carry algebras.
     fp = (
         fingerprint(corpus, queries, cfg)
         + f":ring{ring_n}x{dp}:{int(overlap)}:{cfg.ring_schedule}"
+        + f":{cfg.ring_fusion}"
     )
     if cfg.center and cfg.metric == "l2":
         # centering accumulates the corpus mean in f32 on the device path

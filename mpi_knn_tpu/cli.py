@@ -34,6 +34,8 @@ from mpi_knn_tpu.config import (
     MERGE_SCHEDULES,
     METRICS,
     PRECISION_POLICIES,
+    RING_FUSED_ROTATIONS,
+    RING_FUSIONS,
     RING_SCHEDULES,
     TIE_BREAKS,
     TOPK_METHODS,
@@ -108,6 +110,21 @@ def build_parser() -> argparse.ArgumentParser:
                    "blocks circulate both torus directions at once, "
                    "floor(P/2)+1 rounds, same results bit-identically — "
                    "the comm critical path halves on real ICI)")
+    k.add_argument("--ring-fusion", choices=list(RING_FUSIONS),
+                   default="xla",
+                   help="who owns the ring rotation: xla (ppermute + "
+                   "kernel as separate ops, compiler-scheduled overlap) or "
+                   "fused (the collective-matmul form — async remote "
+                   "copies issued from INSIDE the Pallas distance kernel, "
+                   "the next block streaming over ICI while the current "
+                   "one is on the MXU; bit-identical results, requires "
+                   "the overlap schedule)")
+    k.add_argument("--ring-fused-rotation",
+                   choices=list(RING_FUSED_ROTATIONS), default="round",
+                   help="fused-form launch granularity: round (one kernel "
+                   "per ring round, works everywhere the fused form does) "
+                   "or grid (whole rotation as ONE kernel launch with "
+                   "rounds on the grid axis; TPU-only, uni/exact)")
     k.add_argument("--ring-transfer-dtype",
                    choices=["bfloat16", "float32", "int8"],
                    default=None,
@@ -387,6 +404,8 @@ def main(argv=None) -> int:
         topk_block=args.topk_block,
         merge_schedule=args.merge_schedule,
         ring_schedule=args.ring_schedule,
+        ring_fusion=args.ring_fusion,
+        ring_fused_rotation=args.ring_fused_rotation,
         ring_transfer_dtype=args.ring_transfer_dtype,
         pallas_variant=args.pallas_variant,
         exclude_zero=not args.include_zero_dist,

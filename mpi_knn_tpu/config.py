@@ -24,6 +24,21 @@ TOPK_METHODS = ("exact", "approx", "approx-rerank", "block", "bf16")
 PRECISION_POLICIES = ("exact", "mixed")
 MERGE_SCHEDULES = ("stream", "twolevel")
 RING_SCHEDULES = ("uni", "bidir")
+# transport/compute fusion level of the ring backends:
+# "xla"   — ppermute + XLA/Pallas distance compute as separate HLO ops,
+#           overlap certified by lint rule R1 (today's form);
+# "fused" — the collective-matmul form: one Pallas kernel per round both
+#           computes the resident block's distance tiles AND streams the
+#           block to the next device (async remote DMA on TPU; interpret-
+#           mode compute + the identical-bytes ppermute transport on CPU).
+RING_FUSIONS = ("xla", "fused")
+# rotation granularity of the fused kernel: "round" = one kernel launch
+# per ring round (the form the CPU interpret parity matrix certifies);
+# "grid" = the whole P-round rotation as one kernel with rounds on the
+# major grid axis and the block double-buffered in two HBM slots —
+# experimental, TPU-only (remote DMA between rounds cannot be emulated
+# inside one interpret-mode launch), uni/exact only.
+RING_FUSED_ROTATIONS = ("round", "grid")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
 KMEANS_INITS = ("kmeans++", "random")
@@ -165,6 +180,23 @@ class KNNConfig:
     #           precision_policy because the per-round block merge is the
     #           same shared tile reduction.
     ring_schedule: str = "uni"
+    # transport/compute fusion of the ring backends (RING_FUSIONS above).
+    # "fused" moves the rotation *inside* the Pallas distance kernel
+    # (ops/pallas_ring.py): the resident block is on the MXU while the
+    # async remote copy streams it to the neighbor, hiding the ICI
+    # latency the "xla" form merely lets the compiler schedule around.
+    # Requires the overlap schedule (backends/ring.py refuses blocking),
+    # metric="l2" and dtype="float32" (the kernel's compute contract —
+    # the WIRE may still be bf16/int8 via ring_transfer_dtype; int8
+    # codes+scales are DMA'd as-is and dequantized into the in-kernel
+    # compress dot), and topk_method="exact" (the in-kernel carry merge
+    # is the exact sweep, bit-identical to lax.top_k — certified by the
+    # interpret-mode parity matrix in tests/test_ring_fused.py).
+    ring_fusion: str = "xla"
+    # fused-rotation granularity (RING_FUSED_ROTATIONS above). "grid" is
+    # the whole-rotation single-launch variant behind this flag: TPU-only,
+    # ring_schedule="uni" + precision_policy="exact" only.
+    ring_fused_rotation: str = "round"
     # pallas backend kernel shape: "tiles" = per-(q,c)-tile local top-k +
     # one XLA cross-tile merge (honors topk_method there); "sweep" = whole
     # corpus swept on the minor grid axis with the carry in VMEM scratch,
@@ -316,6 +348,52 @@ class KNNConfig:
                 f"ring_schedule must be one of {RING_SCHEDULES}, got "
                 f"{self.ring_schedule!r}"
             )
+        if self.ring_fusion not in RING_FUSIONS:
+            raise ValueError(
+                f"ring_fusion must be one of {RING_FUSIONS}, got "
+                f"{self.ring_fusion!r}"
+            )
+        if self.ring_fused_rotation not in RING_FUSED_ROTATIONS:
+            raise ValueError(
+                "ring_fused_rotation must be one of "
+                f"{RING_FUSED_ROTATIONS}, got {self.ring_fused_rotation!r}"
+            )
+        if self.ring_fusion == "fused":
+            if self.metric != "l2":
+                raise ValueError(
+                    "ring_fusion='fused' supports metric='l2' only: the "
+                    "fused rotation kernel computes the squared-L2 tile "
+                    f"in-kernel (got metric={self.metric!r})"
+                )
+            if self.dtype != "float32":
+                raise ValueError(
+                    "ring_fusion='fused' requires dtype='float32' (the "
+                    "fused kernel's compute contract, like the pallas "
+                    "backend's); compress the WIRE with "
+                    "ring_transfer_dtype='bfloat16'/'int8' instead — got "
+                    f"dtype={self.dtype!r}"
+                )
+            if self.topk_method != "exact":
+                raise ValueError(
+                    "ring_fusion='fused' requires topk_method='exact': "
+                    "the in-kernel carry merge is the exact k-sweep "
+                    "(bit-identical to lax.top_k), so an approximate "
+                    "method could not take effect and would silently "
+                    f"report exact results — got {self.topk_method!r}"
+                )
+            if self.ring_fused_rotation == "grid" and (
+                self.ring_schedule != "uni"
+                or self.precision_policy != "exact"
+            ):
+                raise ValueError(
+                    "ring_fused_rotation='grid' (whole-rotation single "
+                    "launch) supports ring_schedule='uni' with "
+                    "precision_policy='exact' only: bidir needs two "
+                    "opposed DMA streams per round and mixed needs the "
+                    "XLA rerank between rounds — got schedule="
+                    f"{self.ring_schedule!r}, policy="
+                    f"{self.precision_policy!r}"
+                )
         if self.merge_schedule not in MERGE_SCHEDULES:
             raise ValueError(
                 f"merge_schedule must be one of {MERGE_SCHEDULES}, got "

@@ -4,9 +4,20 @@ spend its time on, per batch, with provenance".
 Built on :mod:`mpi_knn_tpu.obs.xplane`: parse every ``.xplane.pb`` a
 profiled run wrote, pick the plane that carries the device work, and
 reduce it to the per-category busy split the serve report embeds next to
-its p50/p99 — matmul / sort-topk / collective / copy / other, plus the
-collective-under-compute overlap fraction (the measured form of lint
-rule R1's "overlap achieved", see ``analysis/README.md``).
+its p50/p99 — matmul / sort-topk / collective / copy / dma-wait /
+other, plus the collective-under-compute overlap fraction (the measured
+form of lint rule R1's "overlap achieved", see ``analysis/README.md``).
+
+The ``dma-wait`` category exists for the fused collective-matmul
+rotation (``ops/pallas_knn`` ring fusion): its ICI transfers are async
+remote copies issued inside the kernel, and the kernel's semaphore
+stalls surface in the trace as explicit wait events. Categorizing those
+as their own bucket — never ``matmul`` — keeps ``overlap_fraction``
+honest on fused runs: a comm stall inside the kernel is the UN-hidden
+part of the transfer, and folding it into compute would count exactly
+the time the overlap failed to hide as if it had been hidden. The
+report surfaces the bucket both in ``busy_ms`` and as the top-level
+``dma_wait_ms`` the fused bench series reads.
 
 Invariant the acceptance test pins: the per-category milliseconds sum to
 the total busy time (every event carries exactly one category), so a
@@ -88,6 +99,10 @@ def attribute_trace(trace_dir: str, top: int = 10) -> dict:
         "collective_span_overlapped_with_matmul_ms":
             rep["collective_span_overlapped_with_matmul_ms"],
         "overlap_fraction": None if frac is None else round(frac, 4),
+        # the fused rotation's in-kernel semaphore stalls, split out of
+        # compute (0.0 on xla-form and CPU traces — absent wait events,
+        # not an unmeasured zero: the category always exists)
+        "dma_wait_ms": rep["busy_ms_by_category"].get("dma-wait", 0.0),
         "top_ops_ms": dict(rep["top_ops_ms"]),
     }
     if casualties:

@@ -23,10 +23,14 @@ has its own unit tests over hand-built wire-format fixtures
 
 :func:`analyze` aggregates parsed events per plane: top ops by total
 self-duration with a category guess (matmul / sort-topk / collective /
-copy / other), busy time per category, and two collective-under-matmul
-overlap metrics (busy-interval overlap, plus the async ``-start``/
-``-done`` span overlap that credits in-flight DMA time hidden under
-compute — the quantitative form of lint rule R1's "overlap achieved").
+copy / dma-wait / other), busy time per category, and two
+collective-under-matmul overlap metrics (busy-interval overlap, plus
+the async ``-start``/``-done`` span overlap that credits in-flight DMA
+time hidden under compute — the quantitative form of lint rule R1's
+"overlap achieved"). The ``dma-wait`` category splits the fused
+kernel's in-kernel semaphore stalls out of compute so the fused
+rotation's overlap numbers stay honest (the stall IS the un-hidden
+remainder of the transfer).
 """
 
 from __future__ import annotations
@@ -163,6 +167,17 @@ def parse_xplane(path: str) -> list[dict]:
 
 
 CATEGORIES = (
+    # dma-wait FIRST: the fused collective-matmul kernel
+    # (ops/pallas_ring.py) issues its ICI transfers with in-kernel async
+    # remote copies and stalls on semaphore waits that the TensorCore
+    # trace emits as explicit wait events. Those stalls are COMM time,
+    # not compute — if the wait markers fell through to "matmul" (many
+    # spell the kernel or fusion they stall inside), every comm stall
+    # would inflate the measured overlap_fraction (the R1 dual) by
+    # counting blocked-on-wire time as compute the transfer hid under.
+    ("dma-wait", ("dma-wait", "dma_wait", "dmawait", "wait-semaphore",
+                  "semaphore-wait", "sem-wait", "semaphore_wait",
+                  "wait_semaphore", "wait-dma", "wait_dma")),
     ("collective", ("collective-permute", "all-reduce", "all-gather",
                     "all-to-all", "ppermute", "reduce-scatter",
                     "collective")),

@@ -510,6 +510,48 @@ def test_categorize_and_analyze_busy_split_with_overlap():
     assert rep["top_ops_ms"] == {"dot.1": 10.0, "ppermute.2": 10.0}
 
 
+def test_dma_wait_is_its_own_category_not_matmul(tmp_path):
+    """The fused rotation's in-kernel semaphore stalls must never be
+    counted as compute: a collective span overlapping a stalled kernel
+    is time the overlap FAILED to hide, and folding the wait into
+    'matmul' would credit exactly that time to overlap_fraction."""
+    assert categorize("DmaWait.3") == "dma-wait"
+    assert categorize("wait-semaphore.1") == "dma-wait"
+    assert categorize("dma_wait (fused ring)") == "dma-wait"
+    # '-done' halves of async collectives keep their collective category
+    # (the span pairing depends on it)
+    assert categorize("collective-permute-done.2") == "collective"
+
+    ms = 1_000_000_000
+    raw = _plane(
+        "/device:TPU:0",
+        _meta(1, "dot.1") + _meta(2, "dma-wait.2")
+        + _meta(3, "collective-permute-start.3")
+        + _meta(4, "collective-permute-done.3")
+        + _line(
+            "XLA Ops", 0,
+            _event(1, 0, 10 * ms)          # compute 0–10
+            + _event(2, 10 * ms, 4 * ms)   # kernel stalls on the wire 10–14
+            + _event(3, 8 * ms, 1 * ms)    # DMA in flight 8–14
+            + _event(4, 13 * ms, 1 * ms),
+        ),
+    )
+    (tmp_path / "t.xplane.pb").write_bytes(raw)
+    out = attribute_trace(str(tmp_path))
+    assert out["busy_ms"]["dma-wait"] == 4.0
+    assert out["dma_wait_ms"] == 4.0
+    assert out["busy_ms"]["matmul"] == 10.0
+    # the invariant: every event still lands in exactly one category
+    assert out["busy_total_ms"] == pytest.approx(
+        sum(out["busy_ms"].values()), abs=1e-6
+    )
+    # span 8–14 overlaps true compute only on 8–10: 2 of 6 ms hidden.
+    # Were the stall miscategorized as matmul, this would read 6/6.
+    assert out["collective_span_ms"] == 6.0
+    assert out["collective_span_overlapped_with_matmul_ms"] == 2.0
+    assert out["overlap_fraction"] == pytest.approx(2 / 6, abs=1e-4)
+
+
 # ---------------------------------------------------------------------------
 # device-time attribution
 
