@@ -437,7 +437,7 @@ def _ring_knn_local(
         # whole-rotation form: rounds ride the kernel's major grid axis,
         # the block double-buffers between two HBM scratch slots
         # (TPU-only; fused_rotation_grid raises off-TPU — config already
-        # pinned this variant to the uni schedule and exact policy)
+        # pinned this variant to uni schedule, exact policy, float wire)
         out_d, out_i = fused_rotation_grid(
             queries,
             query_ids,

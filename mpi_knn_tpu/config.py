@@ -37,7 +37,7 @@ RING_FUSIONS = ("xla", "fused")
 # "grid" = the whole P-round rotation as one kernel with rounds on the
 # major grid axis and the block double-buffered in two HBM slots —
 # experimental, TPU-only (remote DMA between rounds cannot be emulated
-# inside one interpret-mode launch), uni/exact only.
+# inside one interpret-mode launch), uni/exact/float-wire only.
 RING_FUSED_ROTATIONS = ("round", "grid")
 TIE_BREAKS = ("nearest", "lowest", "quirk-serial", "quirk-mpi")
 PALLAS_VARIANTS = ("tiles", "sweep")
@@ -380,6 +380,18 @@ class KNNConfig:
                     "(bit-identical to lax.top_k), so an approximate "
                     "method could not take effect and would silently "
                     f"report exact results — got {self.topk_method!r}"
+                )
+            if (
+                self.ring_fused_rotation == "grid"
+                and self.ring_transfer_dtype == "int8"
+            ):
+                raise ValueError(
+                    "ring_fused_rotation='grid' supports float wire "
+                    "formats only (float32/bfloat16): the grid kernel "
+                    "DMAs raw slot bytes between its HBM double-buffer "
+                    "slots and casts them straight into the distance dot "
+                    "— int8 codes would be cast without dequantization "
+                    "(the scale plumbing belongs to the round form)"
                 )
             if self.ring_fused_rotation == "grid" and (
                 self.ring_schedule != "uni"

@@ -25,7 +25,8 @@ Execution forms, chosen by the driver (backends/ring.py):
 - **TPU, grid mode** (``ring_fused_rotation="grid"``, behind a flag,
   :func:`fused_rotation_grid`) — the whole P-round rotation as ONE kernel
   launch with rounds on the major grid axis and the block double-buffered
-  between two explicit HBM scratch slots; uni/exact only.
+  between two explicit HBM scratch slots, slot reuse gated by a
+  receiver→sender capacity handshake; uni/exact, float wire only.
 - **CPU interpret** (:func:`fused_block_merge`) — the same kernel body
   computes (interpret mode inlines it into the surrounding XLA program),
   transport stays a driver-level ``ppermute`` moving the identical wire
@@ -607,16 +608,37 @@ def _grid_rotation_kernel(
     q_ref, qid_ref, blk0_ref, bid0_ref, cind_ref, cini_ref,
     outd_ref, outi_ref,
     slot_blk, slot_bid, tile_blk, tile_bid, cd_ref, ci_ref,
-    stage_sem, send_sem, recv_sem,
+    stage_sem, send_sem, recv_sem, free_sem,
     *,
     k, dim, exclude_self, exclude_zero, zero_eps, precision,
-    axis_name, c_tile,
+    axis_name, q_tile, c_tile,
 ):
     """Whole-rotation variant: rounds ride the MAJOR grid axis, the block
     double-buffers between two HBM scratch slots (compute reads slot r%2
     while the remote DMA fills the successor's slot (r+1)%2) — one launch
-    for the whole ring. Uni schedule, exact policy, float wire (config
-    enforces; the scale plumbing is left to the round form)."""
+    for the whole ring. Uni schedule, exact policy, float wire — f32 or
+    bf16, upcast at the dot; config refuses int8 transfer for this form
+    and the driver re-asserts it (raw codes cast without dequantization
+    would be silently wrong distances).
+
+    The running top-k carry lives in ONE (q_local, k) VMEM scratch pair
+    sliced per query tile (``q_local·k·8`` bytes resident): the grid
+    sweeps (r, qi, ci) with ci minor, so every query tile's carry must
+    survive the other tiles' cells between its own visits — a (q_tile, k)
+    scratch would be clobbered at every qi switch. Init fires per qi at
+    round 0, emit per qi at the last round's last ci.
+
+    Cross-device sync is the initial neighbor barrier plus a
+    receiver→sender capacity handshake on ``free_sem``: a device's
+    round-r stream overwrites its RIGHT neighbor's slot (r+1)%2, which
+    that neighbor is still staging compute tiles from (its round r-1)
+    until its last cell — so each device releases a slot to its LEFT
+    neighbor once every round-r read of it has retired (the final
+    staging copy AND its own send DMA, hence after the DMA waits), and
+    the sender consumes one release before every stream after the
+    first. Without it, device skew
+    lets a fast sender corrupt an in-use buffer (the recv-semaphore chain
+    alone only orders arrivals, not slot reuse)."""
     r, qi, ci = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     n_r, n_q, n_c = (
         pl.num_programs(0), pl.num_programs(1), pl.num_programs(2)
@@ -627,8 +649,11 @@ def _grid_rotation_kernel(
     left = jax.lax.rem(my_id + num_dev - 1, num_dev)
     slot = jax.lax.rem(r, 2)
     nxt = jax.lax.rem(r + 1, 2)
+    first_cell = jnp.logical_and(qi == 0, ci == 0)
+    last_cell = jnp.logical_and(qi == n_q - 1, ci == n_c - 1)
+    rows = pl.ds(qi * q_tile, q_tile)  # this query tile's carry slice
 
-    @pl.when(jnp.logical_and(r == 0, jnp.logical_and(qi == 0, ci == 0)))
+    @pl.when(jnp.logical_and(r == 0, first_cell))
     def _boot():
         # stage the resident block into slot 0 (local HBM→HBM copy), then
         # one whole-rotation neighbor barrier
@@ -658,16 +683,26 @@ def _grid_rotation_kernel(
         ]
 
     @pl.when(
-        jnp.logical_and(r < n_r - 1, jnp.logical_and(qi == 0, ci == 0))
+        jnp.logical_and(r > 0, jnp.logical_and(r < n_r - 1, first_cell))
     )
+    def _backpressure():
+        # the slot this round's stream lands in (right neighbor's
+        # (r+1)%2) was being staged from during its round r-1 — consume
+        # one capacity release before overwriting it. The wait at round r
+        # consumes the r-th release, so it proves the neighbor finished
+        # ALL reads through its round r-1 (counting order, device skew
+        # notwithstanding). Round 0 streams into a never-read slot.
+        pltpu.semaphore_wait(free_sem, 1)
+
+    @pl.when(jnp.logical_and(r < n_r - 1, first_cell))
     def _stream():
         for copy in remote_copies():
             copy.start()
 
     @pl.when(jnp.logical_and(r == 0, ci == 0))
     def _init():
-        cd_ref[:] = cind_ref[:]
-        ci_ref[:] = cini_ref[:]
+        cd_ref[rows] = cind_ref[:]
+        ci_ref[rows] = cini_ref[:]
 
     # stage this cell's (c_tile) compute tile out of the resident HBM slot
     # (slots live outside BlockSpec's automatic staging)
@@ -684,26 +719,41 @@ def _grid_rotation_kernel(
         exclude_self=exclude_self, exclude_zero=exclude_zero,
         zero_eps=zero_eps, precision=precision, compress=False,
     )
-    all_d = jnp.concatenate([cd_ref[:], d], axis=1)
+    all_d = jnp.concatenate([cd_ref[rows], d], axis=1)
     all_i = jnp.concatenate(
-        [ci_ref[:], jnp.broadcast_to(tile_bid[:][:, 0][None, :], d.shape)],
+        [ci_ref[rows], jnp.broadcast_to(tile_bid[:][:, 0][None, :], d.shape)],
         axis=1,
     )
     md, mi = _k_smallest_sweep(all_d, all_i, k)
-    cd_ref[:] = md
-    ci_ref[:] = mi
-
-    last_cell = jnp.logical_and(qi == n_q - 1, ci == n_c - 1)
+    cd_ref[rows] = md
+    ci_ref[rows] = mi
 
     @pl.when(jnp.logical_and(r < n_r - 1, last_cell))
     def _wait():
         for copy in remote_copies():
             copy.wait()
 
-    @pl.when(jnp.logical_and(r == n_r - 1, last_cell))
+    @pl.when(jnp.logical_and(r < n_r - 2, last_cell))
+    def _release():
+        # ALL of round r's reads of slot r%2 are now retired — the last
+        # staging copy above and (order matters: this sits AFTER _wait's
+        # send-semaphore wait) the round's own send DMA out of the slot —
+        # so release it to the left neighbor, whose round-(r+1) stream
+        # overwrites it. No release for the final two rounds: r = n_r-2
+        # feeds the last stream that waits (round n_r-2's wait consumes
+        # round n_r-3's release); a later release would leave the
+        # semaphore nonzero at kernel exit.
+        pltpu.semaphore_signal(
+            free_sem, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    @pl.when(jnp.logical_and(r == n_r - 1, ci == n_c - 1))
     def _emit():
-        outd_ref[:] = cd_ref[:]
-        outi_ref[:] = ci_ref[:]
+        # per QUERY TILE, not per launch: outd/outi blocks are keyed by
+        # qi, and each block's final HBM flush is its last-round visit
+        outd_ref[:] = cd_ref[rows]
+        outi_ref[:] = ci_ref[rows]
 
 
 def fused_rotation_grid(
@@ -714,7 +764,17 @@ def fused_rotation_grid(
     TPU-only — the between-round remote DMA cannot be emulated inside one
     interpret-mode evaluation, so off-TPU callers must use the per-round
     form (the one the CPU parity matrix certifies). Config already pins
-    this variant to uni/exact."""
+    this variant to uni/exact and a float wire."""
+    if not jnp.issubdtype(block.dtype, jnp.floating):
+        # config refuses int8 transfer for the grid form; re-assert at
+        # the kernel boundary so a relaxed config could never stream raw
+        # quantized codes into a plain float cast (silently wrong
+        # distances — the scale plumbing belongs to the round form)
+        raise ValueError(
+            "ring_fused_rotation='grid' supports float wire formats only "
+            "(f32/bf16): the grid kernel casts slot bytes straight into "
+            f"the distance dot, got block dtype {block.dtype}"
+        )
     if jax.default_backend() != "tpu":
         raise ValueError(
             "ring_fused_rotation='grid' runs the whole rotation as one "
@@ -736,6 +796,7 @@ def fused_rotation_grid(
         zero_eps=cfg.zero_eps,
         precision=_exact_precision(cfg),
         axis_name=axis_name,
+        q_tile=q_tile,
         c_tile=c_tile,
     )
     carry_spec = pl.BlockSpec(
@@ -765,11 +826,15 @@ def fused_rotation_grid(
             pltpu.HBM((2,) + bid2.shape, bid2.dtype),
             pltpu.VMEM((c_tile, pd), block.dtype),  # staged compute tile
             pltpu.VMEM((c_tile, 1), bid2.dtype),
-            pltpu.VMEM((q_tile, cfg.k), jnp.float32),
-            pltpu.VMEM((q_tile, cfg.k), jnp.int32),
+            # per-query-tile carries, FULL q_local rows: the (r, qi, ci)
+            # sweep leaves each qi's carry parked across every other
+            # tile's cells, so the whole (q_local, k) pair stays resident
+            pltpu.VMEM((q_local, cfg.k), jnp.float32),
+            pltpu.VMEM((q_local, cfg.k), jnp.int32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,  # slot-free capacity handshake
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id

@@ -179,3 +179,42 @@ def test_grid_rotation_refuses_interpret_mode():
             query_tile=8, corpus_tile=16,
             ring_fusion="fused", ring_fused_rotation="grid",
         )
+
+
+def test_grid_rotation_config_refuses_int8_wire():
+    """The grid form's float-wire contract is an EXPLICIT config rule,
+    not a transitive accident of int8⇒mixed⇒not-grid: the kernel DMAs
+    raw slot bytes and casts them into the dot, so int8 codes would skip
+    dequantization. Pinned so relaxing either neighboring rule (int8's
+    mixed requirement, grid's exact pin) can't silently admit it."""
+    with pytest.raises(ValueError, match="float wire"):
+        KNNConfig(
+            k=3, query_tile=8, corpus_tile=16,
+            ring_fusion="fused", ring_fused_rotation="grid",
+            precision_policy="mixed", ring_transfer_dtype="int8",
+        )
+
+
+def test_grid_rotation_kernel_asserts_float_wire():
+    """Defense in depth at the kernel boundary: fused_rotation_grid
+    itself refuses a non-float block (before the TPU-only check, so the
+    guard is testable off-TPU) — a future config relaxation could never
+    stream quantized codes into the plain float cast."""
+    import jax.numpy as jnp
+
+    from mpi_knn_tpu.ops.pallas_ring import fused_rotation_grid
+
+    cfg = KNNConfig(
+        k=3, query_tile=8, corpus_tile=16,
+        ring_fusion="fused", ring_fused_rotation="grid",
+    )
+    with pytest.raises(ValueError, match="float wire"):
+        fused_rotation_grid(
+            jnp.zeros((8, 4), jnp.float32),
+            jnp.arange(8, dtype=jnp.int32),
+            jnp.zeros((16, 4), jnp.int8),  # quantized codes: refused
+            jnp.arange(16, dtype=jnp.int32),
+            jnp.full((8, 3), jnp.inf, jnp.float32),
+            jnp.full((8, 3), -1, jnp.int32),
+            cfg=cfg, q_tile=8, c_tile=16, axis_name="ring", num_dev=2,
+        )
