@@ -89,6 +89,8 @@ def default_targets() -> list[HostTarget]:
             ("frontend.scheduler", p("frontend/scheduler.py")),
             ("frontend.server", p("frontend/server.py")),
             ("frontend.loadgen", p("frontend/loadgen.py")),
+            ("frontend.router", p("frontend/router.py")),
+            ("frontend.modelreplica", p("frontend/modelreplica.py")),
             ("frontend.cli", p("frontend/cli.py")),
         )),
         HostTarget("serve.engine", (("serve.engine", p("serve/engine.py")),)),
@@ -116,6 +118,9 @@ def default_guards() -> GuardMap:
             "_tickets": "_lock",
             "_stop": "_lock",
             "_crashed": "_lock",
+            # the router's mutation high-water mark (ISSUE 18): written
+            # by handler threads at apply, read by /healthz snapshots
+            "_applied_seq": "_lock",
         },
         confined={
             # the pump is the only thread that dispatches and scatters;
@@ -148,6 +153,62 @@ def default_guards() -> GuardMap:
         instance_per_thread="http-handler",
     )
     g.classes["frontend.server.FrontendHTTPServer"] = ClassGuard()
+    g.classes["frontend.server._tuned_server_class.TunedHTTPServer"] = (
+        ClassGuard(
+            guarded={
+                # accept thread adds, handler threads discard at
+                # connection end, the stopping thread severs the rest
+                "_live_socks": "_live_lock",
+            },
+        )
+    )
+
+    # -- frontend.router (ISSUE 18) ---------------------------------------
+    # lock order (H2): _mutlock -> _lock, strict; _plock is a leaf. The
+    # pure state machines (Membership, ReplicaState, MutationLog) carry
+    # no locks of their own — each is serialized by exactly one of the
+    # router's locks, declared here.
+    g.classes["frontend.router.Router"] = ClassGuard(
+        guarded={
+            "_inflight": "_lock",
+            "_pools": "_plock",
+            # the mutation log is the ordering authority: every touch
+            # (sequencing, gap computation, the lag gauge) holds the
+            # mutation lock
+            "log": "_mutlock",
+        },
+    )
+    g.classes["frontend.router.Membership"] = ClassGuard(
+        serialized_by="frontend.router.Router._lock",
+    )
+    g.classes["frontend.router.ReplicaState"] = ClassGuard(
+        serialized_by="frontend.router.Router._lock",
+    )
+    g.classes["frontend.router.MutationLog"] = ClassGuard(
+        serialized_by="frontend.router.Router._mutlock",
+    )
+    g.classes["frontend.router._router_handler.Handler"] = ClassGuard(
+        instance_per_thread="http-handler",
+    )
+    g.classes["frontend.router.RouterHTTPServer"] = ClassGuard()
+    g.classes["frontend.router.ReplicaSupervisor"] = ClassGuard(
+        guarded={
+            "_pids": "_lock",
+            "_last": "_lock",
+        },
+    )
+    g.classes["frontend.modelreplica.ModelReplica"] = ClassGuard(
+        guarded={
+            "_applied_seq": "_lock",
+            "_mutations": "_lock",
+            "_queries": "_lock",
+            "_waiting": "_lock",
+            "_failing": "_lock",
+        },
+    )
+    g.classes["frontend.modelreplica._model_handler.Handler"] = (
+        ClassGuard(instance_per_thread="http-handler")
+    )
 
     # -- serve engine -----------------------------------------------------
     g.classes["serve.engine.ServeSession"] = ClassGuard(
@@ -245,10 +306,25 @@ def default_guards() -> GuardMap:
         "serve.engine.ServeSession._metrics": "obs.metrics.MetricsRegistry",
         "frontend.server.FrontendHTTPServer.frontend":
             "frontend.server.Frontend",
+        "frontend.router.Router.membership":
+            "frontend.router.Membership",
+        "frontend.router.Router.log": "frontend.router.MutationLog",
+        "frontend.router.Router.supervisor":
+            "frontend.router.ReplicaSupervisor",
+        "frontend.router.RouterHTTPServer.router":
+            "frontend.router.Router",
     })
     g.name_types["frontend.server"] = {
         # the handler closure's captured front end
         "frontend": "frontend.server.Frontend",
+    }
+    g.name_types["frontend.router"] = {
+        # the handler closure's captured router
+        "router": "frontend.router.Router",
+    }
+    g.name_types["frontend.modelreplica"] = {
+        # the handler closure's captured replica
+        "replica": "frontend.modelreplica.ModelReplica",
     }
     g.callbacks.update({
         # scheduler → session, wired as bare lambdas in Frontend.__init__
@@ -265,8 +341,17 @@ def default_guards() -> GuardMap:
         "http-handler": [
             "frontend.server._http_handler.Handler.do_POST",
             "frontend.server._http_handler.Handler.do_GET",
+            "frontend.router._router_handler.Handler.do_POST",
+            "frontend.router._router_handler.Handler.do_GET",
+            "frontend.modelreplica._model_handler.Handler.do_POST",
+            "frontend.modelreplica._model_handler.Handler.do_GET",
         ],
         "dispatch-pump": ["frontend.server.Frontend._run"],
+        # the router's own threads (ISSUE 18)
+        "router-prober": ["frontend.router.Router._probe_loop"],
+        "replica-supervisor": [
+            "frontend.router.ReplicaSupervisor._supervise",
+        ],
         "warm-pool": [
             "serve.engine.ServeSession.warm",
             "serve.engine.ServeSession.warm._one",

@@ -311,6 +311,14 @@ def main(argv=None) -> int:
         from mpi_knn_tpu.frontend.cli import loadgen_main
 
         return loadgen_main(argv[1:])
+    if argv and argv[0] == "router":
+        # replicated serving tier (ISSUE 18): a jax-free router fronting
+        # N `mpi-knn serve` replicas — health-gated membership, tenant-
+        # affine spread, sequenced mutation fan-out, optional supervised
+        # replica spawning. Same routing pattern as serve/loadgen.
+        from mpi_knn_tpu.frontend.cli import router_main
+
+        return router_main(argv[1:])
     if argv and argv[0] == "mutate":
         # live-mutation subcommand (ISSUE 14): upsert/delete/compact a
         # saved index artifact offline, or POST mutations to a running

@@ -11,14 +11,23 @@ Layout::
     server.py      the only impure parts: dispatch pump thread + stdlib
                    HTTP server (POST /query, GET /metrics, GET /healthz)
     loadgen.py     open-loop multi-tenant load generation (in-process
-                   and HTTP transports), throughput-vs-p99 rows
-    cli.py         `mpi-knn serve` / `mpi-knn loadgen`
+                   and HTTP transports, keep-alive connection pools,
+                   multi-target), throughput-vs-p99 rows
+    router.py      the replicated tier (ISSUE 18): health-gated
+                   membership, tenant-affine (rendezvous) spread with
+                   least-queued spill, sequenced mutation fan-out with
+                   bounded replay, supervised replica spawning
+    modelreplica.py jax-free deterministic-service stand-in replica
+                   (the router's scaling proof on 1-core CI hosts)
+    cli.py         `mpi-knn serve` / `mpi-knn loadgen` / `mpi-knn router`
 
 Public surface::
 
     from mpi_knn_tpu.frontend import (
         Coalescer, SLOPolicy, FrontendScheduler, Rejection,
         Frontend, FrontendHTTPServer,
+        Router, RouterPolicy, RouterHTTPServer, ReplicaSupervisor,
+        Membership, MutationLog, ModelReplica,
     )
 
 Like ``resilience`` and ``obs``, the package is import-lazy (PEP 562)
@@ -43,6 +52,19 @@ _EXPORTS = {
         "mpi_knn_tpu.frontend.server", "FrontendHTTPServer"
     ),
     "Ticket": ("mpi_knn_tpu.frontend.server", "Ticket"),
+    "Router": ("mpi_knn_tpu.frontend.router", "Router"),
+    "RouterPolicy": ("mpi_knn_tpu.frontend.router", "RouterPolicy"),
+    "RouterHTTPServer": (
+        "mpi_knn_tpu.frontend.router", "RouterHTTPServer"
+    ),
+    "ReplicaSupervisor": (
+        "mpi_knn_tpu.frontend.router", "ReplicaSupervisor"
+    ),
+    "Membership": ("mpi_knn_tpu.frontend.router", "Membership"),
+    "MutationLog": ("mpi_knn_tpu.frontend.router", "MutationLog"),
+    "ModelReplica": (
+        "mpi_knn_tpu.frontend.modelreplica", "ModelReplica"
+    ),
     "loadgen": ("mpi_knn_tpu.frontend", "loadgen"),
 }
 

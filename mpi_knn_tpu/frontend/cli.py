@@ -353,8 +353,21 @@ def build_loadgen_parser() -> argparse.ArgumentParser:
         "`mpi-knn serve` (throughput-vs-p50/p99 rows; open loop so an "
         "overloaded server shows growing latency, not a slowing client)",
     )
-    p.add_argument("--url", required=True,
+    p.add_argument("--url", default=None,
                    help="server base URL (e.g. http://127.0.0.1:8080)")
+    p.add_argument("--targets", default=None, metavar="URL1,URL2,...",
+                   help="drive several endpoints at once (tenant i pins "
+                   "to target i mod N — the router drill's multi-replica "
+                   "direct baseline); replaces --url")
+    p.add_argument("--connect", choices=["reuse", "per-request"],
+                   default="reuse",
+                   help="HTTP transport: 'reuse' = fixed worker pool "
+                   "with persistent keep-alive connections (default); "
+                   "'per-request' = legacy fresh connect + thread per "
+                   "request")
+    p.add_argument("--connections", type=int, default=4,
+                   help="keep-alive connections per tenant stream "
+                   "(reuse mode)")
     p.add_argument("--tenants", type=int, default=4,
                    help="concurrent tenant streams")
     p.add_argument("--qps", type=float, default=20.0,
@@ -381,6 +394,20 @@ def loadgen_main(argv=None) -> int:
     if args.qps <= 0:
         print("error: --qps must be > 0", file=sys.stderr)
         return 2
+    if args.connections < 1:
+        print("error: --connections must be >= 1", file=sys.stderr)
+        return 2
+    targets = None
+    if args.targets:
+        targets = [u.strip() for u in args.targets.split(",") if u.strip()]
+        if not targets:
+            print(f"error: bad --targets {args.targets!r}",
+                  file=sys.stderr)
+            return 2
+    if targets is None and not args.url:
+        print("error: one of --url / --targets is required",
+              file=sys.stderr)
+        return 2
     levels = [args.qps]
     if args.sweep:
         try:
@@ -395,23 +422,29 @@ def loadgen_main(argv=None) -> int:
 
     from mpi_knn_tpu.frontend import loadgen
 
+    probe_url = targets[0] if targets else args.url
     try:
-        health = loadgen.probe_server(args.url, timeout_s=args.timeout_s)
+        health = loadgen.probe_server(probe_url, timeout_s=args.timeout_s)
     except OSError as e:
-        print(f"error: cannot reach {args.url}: {e}", file=sys.stderr)
+        print(f"error: cannot reach {probe_url}: {e}", file=sys.stderr)
         return 2
     if not args.quiet:
         print(
-            f"[mpi-knn loadgen] {args.url}: backend={health['backend']} "
+            f"[mpi-knn loadgen] {probe_url}"
+            + (f" (+{len(targets) - 1} more)"
+               if targets and len(targets) > 1 else "")
+            + f": backend={health['backend']} "
             f"dim={health['dim']} k={health['k']} "
-            f"max_batch_rows={health['max_batch_rows']}"
+            f"max_batch_rows={health['max_batch_rows']} "
+            f"connect={args.connect}"
         )
     rows_out = []
     for qps in sorted(levels):
         rep = loadgen.run_http(
-            args.url, tenants=args.tenants, qps=qps,
+            args.url, targets=targets, tenants=args.tenants, qps=qps,
             n_requests=args.requests, rows=args.rows,
-            timeout_s=args.timeout_s,
+            timeout_s=args.timeout_s, connect=args.connect,
+            connections=args.connections,
         )
         rows_out.append(rep)
         if not args.quiet:
@@ -432,12 +465,197 @@ def loadgen_main(argv=None) -> int:
 
         atomic_write_text(args.report, json.dumps({
             "schema": "mpi_knn_tpu.frontend.loadgen/1",
-            "url": args.url,
+            "url": probe_url,
+            "targets": targets,
+            "connect": args.connect,
             "health": health,
             "rows": rows_out,
         }, indent=1) + "\n")
         if not args.quiet:
             print(f"report written to {args.report}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_router_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi-knn router",
+        description="replicated serving tier (ISSUE 18): a jax-free "
+        "router fronting N `mpi-knn serve` replicas of one artifact — "
+        "health-gated membership, tenant-affine (rendezvous-hash) "
+        "spread with least-queued spill, sequenced mutation fan-out "
+        "with bounded replay, optional supervised replica spawning",
+        epilog="with --spawn, arguments after `--` are passed through "
+        "to every `mpi-knn serve` child (e.g. `mpi-knn router --spawn 3 "
+        "--cache-dir /tmp/aot -- --data synthetic:4096x32c4 --k 10`)",
+    )
+    m = p.add_argument_group("fleet")
+    m.add_argument("--replicas", default=None, metavar="URL1,URL2,...",
+                   help="static fleet: base URLs of running replicas "
+                   "(named r0, r1, ... in probe order)")
+    m.add_argument("--spawn", type=int, default=None, metavar="N",
+                   help="launch and supervise N `mpi-knn serve` children "
+                   "(resilience/worker.py: crashed replicas restart and "
+                   "are health-gated back in); serve flags follow `--`")
+    m.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="shared AOT executable cache for spawned "
+                   "replicas: replica cold start rides the cache, so "
+                   "second-and-later replicas compile zero programs")
+    m.add_argument("--workdir", default=None, metavar="DIR",
+                   help="spawn mode: ready-file directory (default: a "
+                   "fresh temp dir)")
+
+    r = p.add_argument_group("membership / routing")
+    r.add_argument("--probe-interval-ms", type=float, default=500.0,
+                   help="health-poll period (the router's own clock)")
+    r.add_argument("--evict-after", type=int, default=3,
+                   help="consecutive probe failures before eviction")
+    r.add_argument("--rejoin-after", type=int, default=2,
+                   help="consecutive ready probes before (re)join")
+    r.add_argument("--spill-queue-rows", type=int, default=4096,
+                   help="/healthz queue depth beyond which the affine "
+                   "replica spills to the least-queued one")
+    r.add_argument("--replay-buffer", type=int, default=4096,
+                   help="bounded mutation replay buffer (entries); a "
+                   "replica whose gap falls off it is quarantined until "
+                   "cold-reloaded")
+
+    n = p.add_argument_group("network / output")
+    n.add_argument("--host", default="127.0.0.1")
+    n.add_argument("--port", type=int, default=8090,
+                   help="0 = ephemeral (printed, and written to "
+                   "--ready-file)")
+    n.add_argument("--request-timeout-s", type=float, default=30.0)
+    n.add_argument("--ready-file", default=None, metavar="PATH",
+                   help="write the router URL here once listening")
+    n.add_argument("--flight-record", default=None, metavar="JSONL",
+                   help="span flight record (membership transitions, "
+                   "replica exits)")
+    n.add_argument("--metrics-out", default=None, metavar="JSON",
+                   help="write the metrics-registry snapshot at shutdown")
+    n.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("serve_args", nargs=argparse.REMAINDER,
+                   help="after `--`: flags for every spawned `mpi-knn "
+                   "serve` child")
+    return p
+
+
+def router_main(argv=None) -> int:
+    args = build_router_parser().parse_args(argv)
+    if (args.replicas is None) == (args.spawn is None):
+        print("error: exactly one of --replicas / --spawn is required",
+              file=sys.stderr)
+        return 2
+    if args.spawn is not None and args.spawn < 1:
+        print("error: --spawn must be >= 1", file=sys.stderr)
+        return 2
+    if args.replicas is not None and (args.cache_dir or args.workdir):
+        print("error: --cache-dir/--workdir only apply to --spawn "
+              "(a static fleet owns its own caches)", file=sys.stderr)
+        return 2
+    serve_args = list(args.serve_args)
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    if serve_args and args.spawn is None:
+        print("error: serve pass-through args require --spawn",
+              file=sys.stderr)
+        return 2
+
+    if args.flight_record:
+        from mpi_knn_tpu.obs.spans import FlightRecorder, set_recorder
+
+        set_recorder(FlightRecorder(args.flight_record, fresh=True))
+
+    from mpi_knn_tpu.frontend.router import (
+        ReplicaSupervisor,
+        Router,
+        RouterHTTPServer,
+        RouterPolicy,
+    )
+
+    try:
+        policy = RouterPolicy(
+            probe_interval_s=args.probe_interval_ms / 1e3,
+            evict_after=args.evict_after,
+            rejoin_after=args.rejoin_after,
+            spill_queue_rows=args.spill_queue_rows,
+            replay_buffer=args.replay_buffer,
+            request_timeout_s=args.request_timeout_s,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    supervisor = None
+    replicas = None
+    if args.spawn is not None:
+        if args.cache_dir:
+            serve_args += ["--cache-dir", args.cache_dir]
+        workdir = args.workdir
+        if workdir is None:
+            import tempfile
+
+            workdir = tempfile.mkdtemp(prefix="tknn-router-")
+        supervisor = ReplicaSupervisor(
+            args.spawn, serve_args, workdir=workdir
+        ).start()
+    else:
+        urls = [u.strip() for u in args.replicas.split(",") if u.strip()]
+        if not urls:
+            print(f"error: bad --replicas {args.replicas!r}",
+                  file=sys.stderr)
+            return 2
+        replicas = {f"r{i}": u for i, u in enumerate(urls)}
+
+    router = Router(
+        replicas, policy=policy, supervisor=supervisor
+    ).start()
+    server = RouterHTTPServer(
+        router, host=args.host, port=args.port, quiet=args.quiet
+    ).start()
+    if not args.quiet:
+        fleet = (
+            f"{args.spawn} spawned replicas" if supervisor is not None
+            else f"{len(replicas)} static replicas"
+        )
+        print(f"[mpi-knn router] fronting {fleet}; "
+              f"listening on {server.url}", flush=True)
+    if args.ready_file:
+        from mpi_knn_tpu.utils.atomicio import atomic_write_text
+
+        atomic_write_text(args.ready_file, server.url + "\n")
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.stop()
+        router.stop()
+        if supervisor is not None:
+            supervisor.stop()
+        if args.metrics_out:
+            from mpi_knn_tpu.obs.metrics import get_registry
+            from mpi_knn_tpu.utils.atomicio import atomic_write_text
+
+            atomic_write_text(
+                args.metrics_out,
+                json.dumps(get_registry().snapshot(), indent=1) + "\n",
+            )
+        if not args.quiet:
+            st = router.stats()
+            print(
+                f"[mpi-knn router] shutdown: seq={st['seq']} "
+                f"rotation={st['rotation']}"
+            )
     return 0
 
 

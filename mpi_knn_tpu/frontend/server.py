@@ -120,6 +120,11 @@ class Frontend:
         self._dispatched = []  # CoalescedBatch FIFO awaiting retire
         self._stop = False
         self._crashed: BaseException | None = None
+        # highest router-stamped mutation sequence number applied here
+        # (ISSUE 18): the router fans mutations out with X-Mutation-Seq
+        # and reads this back from /healthz to track per-replica lag;
+        # seq <= applied is a replayed duplicate and must not re-apply
+        self._applied_seq = 0
         self.started_s = time.monotonic()
         # declared device profile (ISSUE 16), resolved once here —
         # jax is already loaded by the session, and a construction-time
@@ -282,13 +287,19 @@ class Frontend:
             self._work.notify()
             return ticket
 
-    def upsert(self, tenant: str, ids, rows):
+    def upsert(self, tenant: str, ids, rows, seq: int | None = None):
         """Admit + execute one tenant's upsert (ISSUE 14): 429-governed
         through the scheduler's shared per-tenant budget, then
         dispatched synchronously on this (handler) thread — the index's
         mutation lock serializes it with the pump's batch dispatch, so
         no ticket machinery is needed. Returns the mutation stats dict,
-        or a structured :class:`Rejection`."""
+        or a structured :class:`Rejection`.
+
+        ``seq`` is the router's per-index mutation sequence number
+        (ISSUE 18): a seq at or below the high-water mark is a replayed
+        duplicate — acknowledged without re-applying (and without
+        charging the tenant's mutation budget), so the router's
+        rejoin-replay can safely overlap live fan-out."""
         rows = np.ascontiguousarray(rows, dtype=np.float32)
         with self._lock:
             if self._stop or self._crashed is not None:
@@ -297,16 +308,21 @@ class Frontend:
                     detail="front end is stopping", retry_after_s=0.0,
                     status=503,
                 )
+            if seq is not None and seq <= self._applied_seq:
+                return {"duplicate": True,
+                        "applied_seq": self._applied_seq}
             rej = self.scheduler.admit_mutation(
                 tenant, rows.shape[0], self._clock()
             )
         if rej is not None:
             return rej
-        return self.session.upsert(ids, rows, tenant=str(tenant))
+        out = self.session.upsert(ids, rows, tenant=str(tenant))
+        return self._note_applied(out, seq)
 
-    def delete(self, tenant: str, ids):
+    def delete(self, tenant: str, ids, seq: int | None = None):
         """Admit + execute one tenant's delete — the upsert path's
-        429 governance over the tombstone scatter."""
+        429 governance (and seq-duplicate suppression) over the
+        tombstone scatter."""
         ids = np.asarray(ids).reshape(-1)
         with self._lock:
             if self._stop or self._crashed is not None:
@@ -315,12 +331,27 @@ class Frontend:
                     detail="front end is stopping", retry_after_s=0.0,
                     status=503,
                 )
+            if seq is not None and seq <= self._applied_seq:
+                return {"duplicate": True,
+                        "applied_seq": self._applied_seq}
             rej = self.scheduler.admit_mutation(
                 tenant, max(1, ids.shape[0]), self._clock()
             )
         if rej is not None:
             return rej
-        return self.session.delete(ids, tenant=str(tenant))
+        out = self.session.delete(ids, tenant=str(tenant))
+        return self._note_applied(out, seq)
+
+    def _note_applied(self, out: dict, seq: int | None) -> dict:
+        """Advance the mutation high-water mark AFTER the session applied
+        the mutation (never on admission — a crash between admit and
+        apply must leave the seq unacknowledged so replay re-sends it)."""
+        if seq is not None:
+            with self._lock:
+                if seq > self._applied_seq:
+                    self._applied_seq = seq
+                out["applied_seq"] = self._applied_seq
+        return out
 
     def stats(self) -> dict:
         """The health/posture snapshot ``GET /healthz`` serves.
@@ -337,11 +368,14 @@ class Frontend:
         posture = ses.stats_snapshot()
         with self._lock:
             return {
-                "ok": self._crashed is None,
+                # a stopping frontend FAILS its health check on purpose:
+                # a router must pull a draining replica out of rotation
+                # before its socket goes away (ISSUE 18)
+                "ok": self._crashed is None and not self._stop,
                 # cold-start posture (ISSUE 12): executables ready/total
                 # while warming, and whether start-up warming is done —
                 # the CI gate's time-to-ready rendezvous reads this
-                "ready": self._serving_ready.is_set(),
+                "ready": self._serving_ready.is_set() and not self._stop,
                 "warming": {
                     "ready": warm["ready"],
                     "total": warm["total"],
@@ -362,6 +396,9 @@ class Frontend:
                 # live-mutation posture (ISSUE 14): the session window's
                 # upsert/delete/compaction counts
                 "mutation": posture.get("mutation", {}),
+                # router mutation high-water mark (ISSUE 18): the probe
+                # loop reads per-replica lag from here
+                "applied_seq": self._applied_seq,
                 # what a load generator needs to shape requests
                 "dim": ses.index.dim,
                 "k": ses.cfg.k,
@@ -469,8 +506,84 @@ class Frontend:
 # fill histogram: powers of two around common bucket grids
 _FILL_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
+
+def _tuned_server_class():
+    """``ThreadingHTTPServer`` tuned for a load-bearing loopback tier:
+
+    - the stdlib's accept backlog of 5 DROPS connection bursts (an
+      open-loop generator or a router opening its pool refused at the
+      kernel) — raised to 128;
+    - Nagle + delayed-ACK stalls the headers/body response pair ~40ms
+      per request on KEEP-ALIVE connections (fresh connections hide it
+      behind Linux quickack) — NODELAY is set on the accepted socket
+      here, because ``disable_nagle_algorithm`` is a *handler* knob and
+      the handler classes are per-caller closures;
+    - ``server_close`` SEVERS live keep-alive connections: a threaded
+      stdlib server otherwise leaves handler threads serving pooled
+      connections after shutdown, so a "stopped" server keeps answering
+      its old peers — a zombie a router would keep probing forever
+      while its replacement listens unvisited on the same port. A real
+      process's sockets die with it; an in-process stop must match.
+    """
+    import socket
+
+    from http.server import ThreadingHTTPServer
+
+    class TunedHTTPServer(ThreadingHTTPServer):
+        request_queue_size = 128
+        daemon_threads = True
+
+        def __init__(self, *args, **kwargs):
+            self._live_socks: set = set()
+            self._live_lock = threading.Lock()
+            ThreadingHTTPServer.__init__(self, *args, **kwargs)
+
+        def get_request(self):
+            sock, addr = ThreadingHTTPServer.get_request(self)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._live_lock:
+                self._live_socks.add(sock)
+            return sock, addr
+
+        def shutdown_request(self, request):
+            with self._live_lock:
+                self._live_socks.discard(request)
+            ThreadingHTTPServer.shutdown_request(self, request)
+
+        def server_close(self):
+            ThreadingHTTPServer.server_close(self)
+            with self._live_lock:
+                socks = list(self._live_socks)
+                self._live_socks.clear()
+            for s in socks:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+        def handle_error(self, request, client_address):
+            import sys
+
+            # a peer that went away mid-request (severed connection,
+            # killed client) is routine for a load-bearing tier, not a
+            # traceback; anything else still gets the stdlib report
+            if isinstance(sys.exc_info()[1],
+                          (ConnectionError, TimeoutError, OSError)):
+                return
+            ThreadingHTTPServer.handle_error(
+                self, request, client_address
+            )
+
+    return TunedHTTPServer
+
 TENANT_HEADER = "X-Tenant"
 DEFAULT_TENANT = "default"
+# the router's per-index mutation sequence number (ISSUE 18)
+SEQ_HEADER = "X-Mutation-Seq"
 
 
 def _http_handler(frontend: Frontend, request_timeout_s: float,
@@ -562,6 +675,8 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
             try:
                 doc = self._read_json()
                 ids = doc["ids"]
+                seq_h = self.headers.get(SEQ_HEADER)
+                seq = None if seq_h is None else int(seq_h)
                 if self.path == "/upsert":
                     dim = frontend.session.index.dim
                     rows = np.asarray(doc["rows"], dtype=np.float32)
@@ -579,9 +694,9 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                 return
             try:
                 if self.path == "/upsert":
-                    out = frontend.upsert(tenant, ids, rows)
+                    out = frontend.upsert(tenant, ids, rows, seq=seq)
                 else:
-                    out = frontend.delete(tenant, ids)
+                    out = frontend.delete(tenant, ids, seq=seq)
             except BucketOverflowError as e:
                 self._json(507, {"error": "headroom-exhausted",
                                  "detail": str(e)})
@@ -650,10 +765,8 @@ class FrontendHTTPServer:
     def __init__(self, frontend: Frontend, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: float = 30.0,
                  quiet: bool = True):
-        from http.server import ThreadingHTTPServer
-
         self.frontend = frontend
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _tuned_server_class()(
             (host, port), _http_handler(frontend, request_timeout_s, quiet)
         )
         self._httpd.daemon_threads = True

@@ -538,6 +538,215 @@ else
     fail=1
 fi
 
+note "replicated-tier gate (ISSUE 18: router + rolling-restart drill)"
+# the full replicated story end to end through the production CLIs:
+# pre-warm a shared AOT cache dir with one serve boot, then `mpi-knn
+# router --spawn 3` over it (every child revives the warm set from
+# disk), wait for the health-gated rotation to fill, seed a fanned-out
+# mutation, then the DRILL — SIGKILL one supervised child (pid read
+# from the router's own /healthz children table) under open-loop load.
+# The bar: the client report shows ZERO transport errors and nothing
+# but 200s (in-flight requests on the killed replica are retried on a
+# surviving one — a single-replica death is the router's problem, never
+# the client's); the kill IS visible as membership transitions (evict →
+# restart-detected → join) and a supervisor restart counter; the reborn
+# child proves it rejoined WARM (aot_cache_hits_total > 0, zero serve
+# compiles in its own /metrics); post-churn mutations converge (every
+# replica's applied_seq reaches the router's seq, every lag gauge 0 —
+# scraped from /metrics, re-parsed with the strict parser). Then a
+# production loadgen smoke through the recovered fleet, clean shutdown,
+# and the flight record (membership events, replica exits) passes the
+# schema gate. The membership/replay/affinity BEHAVIOR is tier-1
+# (tests/test_router.py, on modeled replicas); this gate proves the
+# real-process story: real serve children, real SIGKILL, real sockets.
+RT_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP" "$PLAN_TMP" "$FE_TMP" "$MUT_TMP" "$RT_TMP"' EXIT
+RT_SERVE_ARGS="--data synthetic:2048x32c8 --k 10 --partitions 16 \
+    --nprobe 4 --bucket 128 --bucket-headroom 0.5 --mutation-bucket 64"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu serve \
+    $RT_SERVE_ARGS --cache-dir "$RT_TMP/aot" --port 0 \
+    --ready-file "$RT_TMP/warm-ready" -q &
+RT_WARM_PID=$!
+for _ in $(seq 1 180); do
+    [ -s "$RT_TMP/warm-ready" ] && break
+    kill -0 "$RT_WARM_PID" 2>/dev/null || break
+    sleep 1
+done
+kill -TERM "$RT_WARM_PID" 2>/dev/null
+wait "$RT_WARM_PID" 2>/dev/null
+if [ ! -s "$RT_TMP/warm-ready" ]; then
+    echo "router gate: cache pre-warm serve failed to come up"
+    fail=1
+fi
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m mpi_knn_tpu router \
+    --spawn 3 --cache-dir "$RT_TMP/aot" --workdir "$RT_TMP/work" \
+    --probe-interval-ms 100 --port 0 --ready-file "$RT_TMP/ready" \
+    --flight-record "$RT_TMP/flight.jsonl" \
+    --metrics-out "$RT_TMP/metrics.json" -q \
+    -- $RT_SERVE_ARGS &
+RT_PID=$!
+rt_ok=0
+for _ in $(seq 1 120); do
+    [ -s "$RT_TMP/ready" ] && { rt_ok=1; break; }
+    kill -0 "$RT_PID" 2>/dev/null || break
+    sleep 1
+done
+if [ "$rt_ok" = 1 ]; then
+    RT_URL="$(cat "$RT_TMP/ready")"
+    timeout -k 10 600 python - "$RT_URL" <<'RTEOF' || fail=1
+import json, os, signal, sys, threading, time, urllib.request
+
+import numpy as np
+
+from mpi_knn_tpu.frontend import loadgen
+from mpi_knn_tpu.obs.metrics import parse_prometheus
+
+url = sys.argv[1]
+
+
+def healthz():
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        return json.load(r)
+
+
+def scrape(base=None):
+    with urllib.request.urlopen((base or url) + "/metrics",
+                                timeout=30) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def msum(samples, name, **labels):
+    tot = 0.0
+    for key, v in samples.items():
+        if key != name and not key.startswith(name + "{"):
+            continue
+        if all(f'{lk}="{lv}"' in key for lk, lv in labels.items()):
+            tot += v
+    return tot
+
+
+def wait_for(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.5)
+    raise AssertionError("timed out waiting for " + what)
+
+
+def post(path, doc):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", "X-Tenant": "ci"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+# every child revives the pre-warmed cells from the shared cache dir
+wait_for(lambda: len(healthz()["rotation"]) == 3, 420,
+         "3-replica rotation")
+h0 = healthz()
+assert h0["role"] == "router" and h0["dim"] == 32, h0
+victim = h0["rotation"][0]
+pid = h0["children"][victim]["pid"]
+assert pid, f"no supervised pid for {victim}"
+
+# a fanned-out mutation BEFORE the kill, so the rejoin has a real gap
+rng = np.random.default_rng(0)
+rows = lambda n: rng.standard_normal((n, 32)).tolist()  # noqa: E731
+d1 = post("/upsert",
+          {"ids": list(range(990000, 990032)), "rows": rows(32)})
+assert sorted(d1["applied"]) == ["r0", "r1", "r2"], d1
+
+# the DRILL: open-loop load, SIGKILL one supervised child mid-run
+box = {}
+
+
+def _load():
+    box["rep"] = loadgen.run_http(
+        url, tenants=6, qps=4.0, n_requests=20, rows=16,
+        timeout_s=30, connections=6)
+
+
+t = threading.Thread(target=_load)
+t.start()
+time.sleep(1.5)
+os.kill(pid, signal.SIGKILL)
+t.join(300)
+rep = box.get("rep")
+assert rep is not None, "loadgen never returned"
+assert rep["errors"] == 0, f"transport errors under the kill: {rep}"
+assert set(rep["by_status"]) == {"200"}, (
+    f"client saw non-200 under a 1-of-3 kill: {rep['by_status']}")
+
+# the kill is membership's problem, and visibly so
+m1 = scrape()
+assert msum(m1, "router_membership_transitions_total",
+            event="evict") >= 1, "no evict transition recorded"
+wait_for(lambda: len(healthz()["rotation"]) == 3, 300,
+         "the killed replica's rebirth to rejoin")
+m2 = scrape()
+assert msum(m2, "router_replica_restarts_total") >= 1, \
+    "supervisor restart not counted"
+assert msum(m2, "router_membership_transitions_total",
+            event="restart-detected") >= 1, "restart never detected"
+assert msum(m2, "router_membership_transitions_total",
+            event="join") >= 1, "no join transition recorded"
+
+# the reborn child rejoined WARM: the shared AOT cache fed it every
+# executable — zero compiles in its own registry
+child_url = healthz()["children"][victim]["url"]
+cm = scrape(child_url)
+assert cm.get("aot_cache_hits_total", 0) > 0, \
+    "reborn replica shows no AOT cache hits"
+assert cm.get("serve_executables_compiled_total", 0) == 0, (
+    f"reborn replica compiled "
+    f"{cm['serve_executables_compiled_total']:.0f} executables — "
+    "the rejoin was cold")
+
+# post-churn mutations converge: applied_seq reaches the router's seq
+# on every replica (the reborn one replayed its gap in order)
+d2 = post("/upsert",
+          {"ids": list(range(991000, 991032)), "rows": rows(32)})
+assert sorted(d2["applied"]) == ["r0", "r1", "r2"], d2
+post("/delete", {"ids": list(range(990000, 990032))})
+h1 = healthz()
+assert h1["seq"] == 3 and h1["seq"] > h0["seq"], (h0["seq"], h1["seq"])
+wait_for(lambda: all(
+    r["applied_seq"] == 3
+    for r in healthz()["replicas"].values()), 120,
+    "applied_seq convergence on every replica")
+m3 = scrape()
+lags = {k: v for k, v in m3.items()
+        if k.startswith("router_replica_lag")}
+assert lags and all(v == 0 for v in lags.values()), \
+    f"replica lag gauges not drained: {lags}"
+assert msum(m3, "router_replayed_mutations_total") >= 1, \
+    "rejoin replayed nothing despite a seeded gap"
+print(f"router gate: kill-1-of-3 drill green — "
+      f"{len(rep['by_status'])} status class(es), "
+      f"{msum(m3, 'router_requests_total'):.0f} proxied queries, "
+      f"seq {h1['seq']} converged on 3 replicas, reborn child "
+      f"{cm['aot_cache_hits_total']:.0f} cache hits / 0 compiles")
+RTEOF
+    timeout -k 10 120 python -m mpi_knn_tpu loadgen --url "$RT_URL" \
+        --tenants 2 --qps 20 --requests 10 --rows 16 \
+        --report "$RT_TMP/load.json" || fail=1
+    kill -TERM "$RT_PID" 2>/dev/null
+    wait "$RT_PID" || fail=1
+    python -m mpi_knn_tpu metrics --flight "$RT_TMP/flight.jsonl" \
+        --validate || fail=1
+    python -m mpi_knn_tpu metrics "$RT_TMP/metrics.json" --check || fail=1
+else
+    echo "router gate: router failed to come up"
+    kill "$RT_PID" 2>/dev/null
+    fail=1
+fi
+
 note "tier-1 pytest (the ROADMAP.md gate)"
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
