@@ -173,9 +173,13 @@ def default_guards() -> GuardMap:
             "_inflight": "_lock",
             "_pools": "_plock",
             # the mutation log is the ordering authority: every touch
-            # (sequencing, gap computation, the lag gauge) holds the
+            # (sequencing, gap computation, replay planning) holds the
             # mutation lock
             "log": "_mutlock",
+            # the log's (seq, min_seq) posture, published under _lock
+            # after every append so /healthz and the lag gauges never
+            # queue behind _mutlock (held across fan-out/replay I/O)
+            "_log_posture": "_lock",
         },
     )
     g.classes["frontend.router.Membership"] = ClassGuard(
@@ -204,6 +208,7 @@ def default_guards() -> GuardMap:
             "_queries": "_lock",
             "_waiting": "_lock",
             "_failing": "_lock",
+            "_drop_mutations": "_lock",
         },
     )
     g.classes["frontend.modelreplica._model_handler.Handler"] = (

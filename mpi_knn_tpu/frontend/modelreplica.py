@@ -11,14 +11,17 @@ replaces the jax engine with ``lanes`` service lanes of a fixed
 SLEEPING — which a single core can run three of concurrently), while
 speaking the real serve front end's HTTP surface verbatim: ``POST
 /query`` (raw f32 or JSON), ``POST /upsert``/``/delete`` with the
-``X-Mutation-Seq`` duplicate-suppression contract, ``GET /healthz``
-with ``ready``/``applied_seq``/``queue_rows``, keep-alive throughout.
+``X-Mutation-Seq`` contract (duplicate suppression AND the gapless-mark
+409 refusal), ``GET /healthz`` with ``ready``/``applied_seq``/
+``queue_rows``, keep-alive throughout.
 So the router, loadgen, and the scaling/affinity/convergence tests
 exercise the full wire protocol; only the distance math is modeled.
 
 Failure injection for membership tests: :meth:`fail` turns /healthz
 into ``ok: false`` (probe failures → eviction) without dropping the
-socket; :meth:`kill` is the SIGKILL analogue — it stops the listener
+socket; :meth:`drop_mutations` fails only the mutation route (503)
+while health stays green — the transient fan-out-leg failure that must
+leave a replica lagging, never gapped; :meth:`kill` is the SIGKILL analogue — it stops the listener
 AND severs every open keep-alive connection, so in-flight requests
 die with transport errors exactly as a killed process's would;
 :meth:`stop` is the graceful shutdown; :meth:`cold_reload` resets the
@@ -64,6 +67,7 @@ class ModelReplica:
         self._queries = 0
         self._waiting = 0
         self._failing = False
+        self._drop_mutations = False
         self.started_s = time.monotonic()
         self.warm_delay_s = warm_delay_s
         from mpi_knn_tpu.frontend.server import _tuned_server_class
@@ -106,6 +110,14 @@ class ModelReplica:
         soft-death a router must evict on without a socket error."""
         with self._lock:
             self._failing = failing
+
+    def drop_mutations(self, dropping: bool = True) -> None:
+        """Make mutations fail 503 while /healthz stays ok — the
+        TRANSIENT single-leg fan-out failure (a wedged apply, a dropped
+        packet) that must leave this replica lagging-but-in-rotation,
+        never applying later seqs over the hole."""
+        with self._lock:
+            self._drop_mutations = dropping
 
     def cold_reload(self, applied_seq: int = 0) -> None:
         """Reset the mutation state to ``applied_seq`` — a reload from
@@ -175,10 +187,16 @@ class ModelReplica:
     def apply_mutation(self, path: str, tenant: str, ids,
                        seq: int | None) -> dict:
         with self._lock:
-            if self._failing:
+            if self._failing or self._drop_mutations:
                 return {"error": "failing"}
             if seq is not None and seq <= self._applied_seq:
                 return {"duplicate": True,
+                        "applied_seq": self._applied_seq}
+            if seq is not None and seq > self._applied_seq + 1:
+                # the gapless-mark rule (the serve front end's 409):
+                # applying over a hole would lose the missed seq —
+                # refuse, stay lagging, let the router replay in order
+                return {"error": "seq-gap", "status": 409,
                         "applied_seq": self._applied_seq}
             self._mutations.append((seq, path, tenant, list(ids)))
             if seq is not None and seq > self._applied_seq:
@@ -243,7 +261,8 @@ def _model_handler(replica: ModelReplica):
                     self._json(400, {"error": str(e)})
                     return
                 out = replica.apply_mutation(self.path, tenant, ids, seq)
-                self._json(503 if "error" in out else 200, out)
+                status = out.pop("status", 503) if "error" in out else 200
+                self._json(status, out)
             else:
                 self._json(404, {"error": f"no such route {self.path}"})
 

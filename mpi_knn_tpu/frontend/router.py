@@ -30,7 +30,9 @@ behavior worth asserting is a pure state machine here —
   lagging replicas in order. Lock order is ``_mutlock`` → ``_lock``
   (strict): the mutation lock is held across fan-out/replay I/O — that
   is the ordering authority — while the membership lock only covers
-  routing decisions and state, so queries never wait on mutation I/O.
+  routing decisions and state, so queries and ``/healthz`` (which reads
+  the log's posture from a snapshot published under ``_lock``) never
+  wait on mutation I/O.
 - :class:`RouterHTTPServer` — the stdlib ``ThreadingHTTPServer`` shell:
   ``POST /query`` proxies to the chosen replica (structured 503 when
   the rotation is empty, one retry on a different replica when the
@@ -50,6 +52,13 @@ Replica-side contract (``frontend/server.py``): mutations carrying
 ``X-Mutation-Seq`` advance an ``applied_seq`` high-water mark exposed in
 ``/healthz``; a seq at or below the mark is a replayed duplicate —
 acknowledged, never re-applied — so replay may overlap live fan-out.
+The mark is GAPLESS: a replica refuses a seq beyond ``applied_seq + 1``
+with 409 (outside the deterministic set, so the router never acks it),
+because applying over a hole would silently lose the missed mutation —
+the router's in-order replay is the only path that advances a lagging
+replica. Deterministic refusals (400/507) consume their seq exactly as
+an apply would (a replay could only repeat them; a position that did
+not advance would wedge the stream on 409 forever).
 
 No jax import anywhere in this module: the router is exactly the layer
 that must run on a box with no accelerator.
@@ -98,7 +107,11 @@ class RouterPolicy:
     # window a replica may sleep through and still be replayed forward
     replay_buffer: int = 4096
     request_timeout_s: float = 30.0
-    mutation_timeout_s: float = 30.0
+    # per fan-out/replay leg: deliberately much shorter than the query
+    # timeout — a failed leg is replayed by the probe loop anyway, and
+    # the leg runs under the mutation lock, so one wedged replica must
+    # bound how long it can stall every other mutation
+    fanout_timeout_s: float = 5.0
 
     def __post_init__(self):
         if self.evict_after < 1 or self.rejoin_after < 1:
@@ -147,8 +160,16 @@ class ReplicaState:
     ok_streak: int = 0
     fail_streak: int = 0
     ready: bool = False
-    # the replica's own /healthz high-water mark, from the last probe
+    # the replica's own high-water mark — from the last probe, advanced
+    # between probes by each 200 fan-out/replay leg's response (a
+    # restart in the probe gap must not be compared against a mark
+    # staler than the legs the router already saw land)
     applied_seq: int = 0
+    # the replica's last reported /healthz uptime_s: the LIFE marker.
+    # Within one life both uptime and applied_seq are monotone; an
+    # uptime that drops is a restart even when the new life's baseline
+    # happens to equal the last mark
+    uptime_s: float | None = None
     # the router-side acknowledgment horizon: the highest seq this
     # replica gave a DETERMINISTIC response for (2xx, or a 4xx/507 that
     # a replay could only repeat) — transient failures don't advance it
@@ -205,16 +226,33 @@ class Membership:
                 ))
             return events
         applied = int(doc.get("applied_seq", 0))
-        if applied < r.applied_seq:
-            # the process restarted (a high-water mark never goes down
-            # within one life): every router-side acknowledgment is for
-            # a life that no longer exists
+        up = doc.get("uptime_s")
+        up = float(up) if up is not None else None
+        # restart detection: the uptime LIFE marker is authoritative
+        # when both sides report it — a probed doc that raced a fan-out
+        # leg can carry an applied_seq below the leg-updated mark with
+        # no restart, and a restart restored to the last mark shows no
+        # seq regression at all. Without uptime data (a minimal
+        # /healthz), a dropping applied_seq is the only signal.
+        if up is not None and r.uptime_s is not None:
+            restarted = up < r.uptime_s
+        else:
+            restarted = applied < r.applied_seq
+        if restarted:
+            # every router-side acknowledgment was for a life that no
+            # longer exists: resynchronize both marks to what the new
+            # life reports, so the replay planner sees the real gap
             r.acked_seq = applied
+            r.applied_seq = applied
             events.append(self._event(
                 "restart-detected", r, now, applied_seq=applied
             ))
+        else:
+            # same life: the mark never regresses (the probed doc may
+            # trail mutation legs acknowledged since it was rendered)
+            r.applied_seq = max(r.applied_seq, applied)
+        r.uptime_s = up
         r.fail_streak = 0
-        r.applied_seq = applied
         r.queue_rows = int(doc.get("queue_rows", 0))
         r.ready = bool(doc.get("ready", False))
         r.doc = doc
@@ -325,7 +363,9 @@ class MutationLog:
 # replica responses a replay could only repeat: advancing the ack
 # horizon past them keeps the protocol live (a malformed or
 # headroom-overflowing mutation must not wedge replay forever); 429 and
-# 5xx are transient — the next replay cycle retries them
+# 5xx are transient — the next replay cycle retries them — and 409 is
+# the replica's seq-gap refusal (it has not seen seq - 1 yet): the leg
+# stays unacked so the probe loop replays the hole forward in order
 _DETERMINISTIC = frozenset({200, 400, 404, 507})
 
 
@@ -348,6 +388,11 @@ class Router:
         self._plock = threading.Lock()
         self.membership = Membership(self.policy)
         self.log = MutationLog(self.policy.replay_buffer)
+        # (seq, min_seq) published under _lock after every append, so
+        # /healthz and the lag gauges read the log's posture WITHOUT
+        # _mutlock — the mutation lock is held across fan-out/replay
+        # I/O, and one wedged replica must not stall the health surface
+        self._log_posture = (self.log.seq, self.log.min_seq)
         self._inflight: dict[str, int] = {}
         self._pools: dict[tuple, list] = {}
         self.started_s = time.monotonic()
@@ -371,9 +416,22 @@ class Router:
 
     def stop(self) -> None:
         self._stop.set()
-        self._prober.join(
-            self.policy.probe_interval_s + self.policy.probe_timeout_s + 5
-        )
+        if self._prober.ident is not None:  # join only a started thread
+            self._prober.join(
+                self.policy.probe_interval_s
+                + self.policy.probe_timeout_s + 5
+            )
+        # close every pooled keep-alive socket: a daemon-threaded shell
+        # dies with the process, but an embedding test or CLI stops many
+        # routers in one life — their pools must not strand sockets
+        with self._plock:
+            conns = [c for pool in self._pools.values() for c in pool]
+            self._pools.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def wait_rotation(self, n: int, timeout_s: float = 60.0) -> bool:
         """Block until ≥ n replicas are in rotation (startup rendezvous
@@ -435,6 +493,7 @@ class Router:
         # I/O with no lock held: a wedged replica costs probe_timeout_s
         # of this thread, never a lock anyone else wants
         observed = {}
+        urls: dict[str, str | None] = {}
         for name in names:
             url = (
                 self.supervisor.url(name)
@@ -446,8 +505,10 @@ class Router:
                     url = self.membership.replicas[name].url
                 elif url != self.membership.replicas[name].url:
                     self.membership.set_url(name, url)
+            urls[name] = url
             doc = self._fetch_healthz(url) if url else None
             observed[name] = doc
+        self._prune_pools(urls)
         events: list[dict] = []
         with self._mutlock:
             plans = []
@@ -498,14 +559,15 @@ class Router:
                                 self.membership.promote(name, self._clock())
                             )
         self._note_events(events)
-        with self._mutlock:  # lock order: _mutlock -> _lock
-            with self._lock:
-                rotation = len(self.membership.in_rotation())
-                lags = {
-                    name: max(0, self.log.seq
-                              - max(r.applied_seq, r.acked_seq))
-                    for name, r in self.membership.replicas.items()
-                }
+        with self._lock:  # the published posture, never _mutlock: the
+            # gauges must not queue behind replay I/O
+            seq_now = self._log_posture[0]
+            rotation = len(self.membership.in_rotation())
+            lags = {
+                name: max(0, seq_now
+                          - max(r.applied_seq, r.acked_seq))
+                for name, r in self.membership.replicas.items()
+            }
         reg = self._registry()
         reg.gauge(
             "router_rotation_size", help="replicas in rotation"
@@ -523,16 +585,13 @@ class Router:
         if url is None:
             return False
         for seq, path, tenant, body in gap:
-            status, _doc = self._post_to(
+            status, rdoc = self._post_to(
                 name, url, path, body, tenant, seq,
-                timeout_s=self.policy.mutation_timeout_s,
+                timeout_s=self.policy.fanout_timeout_s,
             )
             if status not in _DETERMINISTIC:
                 return False
-            with self._lock:
-                r = self.membership.replicas[name]
-                if seq > r.acked_seq:
-                    r.acked_seq = seq
+            self._note_leg(name, seq, rdoc)
             self._registry().counter(
                 "router_replayed_mutations_total",
                 help="buffered mutations replayed to replicas",
@@ -552,6 +611,21 @@ class Router:
                 "membership", cat="router", event=ev["event"],
                 replica=ev["replica"], state=ev["state"],
             )
+
+    def _note_leg(self, name: str, seq: int, rdoc) -> None:
+        """Fold one DETERMINISTIC fan-out/replay leg into the replica's
+        marks: the ack horizon reaches ``seq``, and the response's own
+        ``applied_seq`` (both serve and modeled replicas stamp it)
+        advances the probed mark BETWEEN probe cycles — restart
+        detection and replay planning must never work from a mark
+        staler than the legs the router already saw land."""
+        rep = rdoc.get("applied_seq") if isinstance(rdoc, dict) else None
+        with self._lock:
+            r = self.membership.replicas[name]
+            if seq > r.acked_seq:
+                r.acked_seq = seq
+            if rep is not None and int(rep) > r.applied_seq:
+                r.applied_seq = int(rep)
 
     # -- connection pooling ----------------------------------------------
 
@@ -580,6 +654,29 @@ class Router:
     def _conn_put(self, name: str, url: str, conn) -> None:
         with self._plock:
             self._pools.setdefault((name, url), []).append(conn)
+
+    def _prune_pools(self, urls: dict) -> None:
+        """Drop (and close) pooled connections whose url is no longer
+        any replica's CURRENT url: a supervised restart publishes a new
+        port, and the old port's sockets would otherwise strand open
+        under the dead key for the process lifetime. ``urls`` maps
+        replica name → current base url (None while unpublished)."""
+        live = {u for u in urls.values() if u}
+        stale = []
+        with self._plock:
+            for key in list(self._pools):
+                name, url = key
+                current = (
+                    url in live if name == "probe"
+                    else urls.get(name) == url
+                )
+                if not current:
+                    stale.extend(self._pools.pop(key))
+        for conn in stale:  # close OUTSIDE _plock (leaf lock, no calls)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- query path -------------------------------------------------------
 
@@ -731,6 +828,8 @@ class Router:
                     "tenant": tenant,
                 }
             seq = self.log.append(path, tenant, body)
+            with self._lock:  # lock order: _mutlock -> _lock
+                self._log_posture = (self.log.seq, self.log.min_seq)
             reg.counter(
                 "router_mutations_total",
                 help="mutations sequenced, by route",
@@ -740,14 +839,11 @@ class Router:
             for name, url in targets:
                 status, rdoc = self._post_to(
                     name, url, path, body, tenant, seq,
-                    timeout_s=self.policy.mutation_timeout_s,
+                    timeout_s=self.policy.fanout_timeout_s,
                 )
                 results[name] = (status, rdoc)
                 if status in _DETERMINISTIC:
-                    with self._lock:
-                        r = self.membership.replicas[name]
-                        if seq > r.acked_seq:
-                            r.acked_seq = seq
+                    self._note_leg(name, seq, rdoc)
                 else:
                     reg.counter(
                         "router_fanout_failures_total",
@@ -801,10 +897,12 @@ class Router:
     # -- posture ----------------------------------------------------------
 
     def stats(self) -> dict:
-        """The router's own ``GET /healthz`` document."""
-        with self._mutlock:
-            seq, min_seq = self.log.seq, self.log.min_seq
+        """The router's own ``GET /healthz`` document. Reads the log's
+        PUBLISHED posture, never ``_mutlock``: the mutation lock is held
+        across fan-out/replay I/O, and the health endpoint must answer
+        while a wedged replica is timing a leg out."""
         with self._lock:
+            seq, min_seq = self._log_posture
             replicas = self.membership.posture()
             rotation = self.membership.in_rotation()
             inflight = dict(sorted(self._inflight.items()))

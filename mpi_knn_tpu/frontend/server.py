@@ -123,7 +123,11 @@ class Frontend:
         # highest router-stamped mutation sequence number applied here
         # (ISSUE 18): the router fans mutations out with X-Mutation-Seq
         # and reads this back from /healthz to track per-replica lag;
-        # seq <= applied is a replayed duplicate and must not re-apply
+        # seq <= applied is a replayed duplicate and must not re-apply,
+        # and seq > applied + 1 is a GAP and must not apply either (409)
+        # — applying over a hole would advance the mark past a mutation
+        # this replica never saw, losing it silently: the router's
+        # in-order replay is the only path that moves a lagging replica
         self._applied_seq = 0
         self.started_s = time.monotonic()
         # declared device profile (ISSUE 16), resolved once here —
@@ -299,7 +303,9 @@ class Frontend:
         (ISSUE 18): a seq at or below the high-water mark is a replayed
         duplicate — acknowledged without re-applying (and without
         charging the tenant's mutation budget), so the router's
-        rejoin-replay can safely overlap live fan-out."""
+        rejoin-replay can safely overlap live fan-out — and a seq past
+        ``applied + 1`` is a gap, refused with a 409-status rejection
+        (the router replays the hole forward in order)."""
         rows = np.ascontiguousarray(rows, dtype=np.float32)
         with self._lock:
             if self._stop or self._crashed is not None:
@@ -308,9 +314,9 @@ class Frontend:
                     detail="front end is stopping", retry_after_s=0.0,
                     status=503,
                 )
-            if seq is not None and seq <= self._applied_seq:
-                return {"duplicate": True,
-                        "applied_seq": self._applied_seq}
+            gate = self._seq_gate(tenant, seq, self._applied_seq)
+            if gate is not None:
+                return gate
             rej = self.scheduler.admit_mutation(
                 tenant, rows.shape[0], self._clock()
             )
@@ -321,7 +327,7 @@ class Frontend:
 
     def delete(self, tenant: str, ids, seq: int | None = None):
         """Admit + execute one tenant's delete — the upsert path's
-        429 governance (and seq-duplicate suppression) over the
+        429 governance (and seq duplicate/gap gating) over the
         tombstone scatter."""
         ids = np.asarray(ids).reshape(-1)
         with self._lock:
@@ -331,9 +337,9 @@ class Frontend:
                     detail="front end is stopping", retry_after_s=0.0,
                     status=503,
                 )
-            if seq is not None and seq <= self._applied_seq:
-                return {"duplicate": True,
-                        "applied_seq": self._applied_seq}
+            gate = self._seq_gate(tenant, seq, self._applied_seq)
+            if gate is not None:
+                return gate
             rej = self.scheduler.admit_mutation(
                 tenant, max(1, ids.shape[0]), self._clock()
             )
@@ -341,6 +347,30 @@ class Frontend:
             return rej
         out = self.session.delete(ids, tenant=str(tenant))
         return self._note_applied(out, seq)
+
+    @staticmethod
+    def _seq_gate(tenant: str, seq: int | None, applied: int):
+        """The stream-order gate — pure in ``applied`` (callers read the
+        mark under ``_lock`` and pass it in): None means the seq is
+        consumable (exactly ``applied + 1``, or unsequenced); a dict is
+        the duplicate acknowledgment; a 409 :class:`Rejection` means the
+        seq would leave a GAP — 409 is outside the router's
+        deterministic set, so the leg stays unacknowledged and the probe
+        loop replays the hole forward in order."""
+        if seq is None:
+            return None
+        if seq <= applied:
+            return {"duplicate": True, "applied_seq": applied}
+        if seq > applied + 1:
+            return Rejection(
+                tenant=str(tenant), reason="seq-gap",
+                detail=(
+                    f"seq {seq} skips ahead of applied_seq "
+                    f"{applied}; refusing to apply out of order"
+                ),
+                retry_after_s=0.5, status=409,
+            )
+        return None
 
     def _note_applied(self, out: dict, seq: int | None) -> dict:
         """Advance the mutation high-water mark AFTER the session applied
@@ -352,6 +382,24 @@ class Frontend:
                     self._applied_seq = seq
                 out["applied_seq"] = self._applied_seq
         return out
+
+    def _note_refused(self, seq: int | None) -> dict | None:
+        """A DETERMINISTIC refusal (400/507) consumed its seq: the
+        stream position advances exactly as an apply would, because a
+        replay could only repeat the refusal — a position that did not
+        advance would make this replica 409 every later seq forever
+        (the stream has no skip marker). Returns the position facts for
+        the refusal body, ``{"gap": True, ...}`` when the seq cannot be
+        consumed in order (the handler must answer 409 seq-gap instead
+        of its refusal), or None for an unsequenced mutation."""
+        if seq is None:
+            return None
+        with self._lock:
+            if seq > self._applied_seq + 1:
+                return {"gap": True, "applied_seq": self._applied_seq}
+            if seq > self._applied_seq:
+                self._applied_seq = seq
+            return {"applied_seq": self._applied_seq}
 
     def stats(self) -> dict:
         """The health/posture snapshot ``GET /healthz`` serves.
@@ -662,6 +710,20 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                 raise ValueError("empty request body")
             return json.loads(self.rfile.read(n))
 
+        def _refuse_mutation(self, status: int, doc: dict, seq) -> None:
+            """Send a DETERMINISTIC refusal (400/507): the seq is
+            consumed (the router acks these — a replay could only
+            repeat them, so the stream position must move past), unless
+            it would leave a gap, which downgrades the answer to a 409
+            the router never acks."""
+            note = frontend._note_refused(seq)
+            if note is not None and note.pop("gap", False):
+                self._json(409, {"error": "seq-gap", **note})
+                return
+            if note is not None:
+                doc = {**doc, **note}
+            self._json(status, doc)
+
         def _do_mutation(self, tenant: str) -> None:
             """POST /upsert {"ids": [...], "rows": [[...]]} and
             POST /delete {"ids": [...]} — tenant-attributed (X-Tenant),
@@ -672,11 +734,12 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
             layouts compact-and-retry inside the session."""
             from mpi_knn_tpu.ivf.mutate import BucketOverflowError
 
+            seq = None
             try:
-                doc = self._read_json()
-                ids = doc["ids"]
                 seq_h = self.headers.get(SEQ_HEADER)
                 seq = None if seq_h is None else int(seq_h)
+                doc = self._read_json()
+                ids = doc["ids"]
                 if self.path == "/upsert":
                     dim = frontend.session.index.dim
                     rows = np.asarray(doc["rows"], dtype=np.float32)
@@ -690,7 +753,7 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                             f"{len(ids)} ids but {rows.shape[0]} rows"
                         )
             except (ValueError, KeyError, TypeError) as e:
-                self._json(400, {"error": str(e)})
+                self._refuse_mutation(400, {"error": str(e)}, seq)
                 return
             try:
                 if self.path == "/upsert":
@@ -698,11 +761,13 @@ def _http_handler(frontend: Frontend, request_timeout_s: float,
                 else:
                     out = frontend.delete(tenant, ids, seq=seq)
             except BucketOverflowError as e:
-                self._json(507, {"error": "headroom-exhausted",
-                                 "detail": str(e)})
+                self._refuse_mutation(
+                    507, {"error": "headroom-exhausted",
+                          "detail": str(e)}, seq,
+                )
                 return
             except ValueError as e:
-                self._json(400, {"error": str(e)})
+                self._refuse_mutation(400, {"error": str(e)}, seq)
                 return
             except Exception as e:  # noqa: BLE001 — serving error
                 self._json(500, {"error": f"{type(e).__name__}: {e}"})

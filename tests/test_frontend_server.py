@@ -186,3 +186,32 @@ def test_http_loadgen_end_to_end(served):
     assert rep["p50_ms"] is not None and rep["p99_ms"] is not None
     assert rep["achieved_qps_rows"] > 0
     assert rep["offered_qps_total"] == pytest.approx(180.0)
+
+
+def test_mutation_seq_gap_is_409_and_refusals_consume_position(served):
+    """The gapless-mark wire contract on the REAL serve front end: a
+    seq past applied+1 is refused 409 (nothing applied, mark
+    unchanged), a deterministic 400 refusal CONSUMES its in-order seq
+    (the stream has no skip marker — an unconsumed position would 409
+    every later seq forever), and the next in-order seq applies."""
+    srv, _fe, _index = served
+    with urllib.request.urlopen(srv.url + "/healthz", timeout=30) as r:
+        a0 = json.loads(r.read())["applied_seq"]
+    hdr = {"Content-Type": "application/json"}
+    row = json.dumps({"ids": [9001], "rows": [[0.0] * DIM]}).encode()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(srv.url, "/upsert", row,
+              {**hdr, "X-Mutation-Seq": str(a0 + 5)})
+    assert ei.value.code == 409
+    assert json.loads(ei.value.read())["error"] == "seq-gap"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(srv.url, "/upsert", b"not json",
+              {**hdr, "X-Mutation-Seq": str(a0 + 1)})
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["applied_seq"] == a0 + 1
+    with urllib.request.urlopen(srv.url + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["applied_seq"] == a0 + 1
+    status, doc = _post(srv.url, "/delete",
+                        json.dumps({"ids": [3]}).encode(),
+                        {**hdr, "X-Mutation-Seq": str(a0 + 2)})
+    assert status == 200 and doc["applied_seq"] == a0 + 2

@@ -684,3 +684,118 @@ def test_mutation_convergence_across_real_replicas(tmp_path):
             except OSError:
                 pass
             fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# review hardening: gapless marks, life markers, lock posture, pool hygiene
+
+
+def test_membership_restart_detected_by_uptime_drop():
+    """A restart restored from an artifact current at the SAME probed
+    mark shows no seq regression; only the uptime LIFE marker dropping
+    reveals the new life (and resets the ack horizon legs had built)."""
+    m = Membership(RouterPolicy())
+    m.add("r0")
+    m.note_probe("r0", {"ok": True, "ready": True, "applied_seq": 3,
+                        "uptime_s": 12.5}, 1.0)
+    m.replicas["r0"].acked_seq = 9  # fan-out legs acked between probes
+    events = m.note_probe("r0", {"ok": True, "ready": True,
+                                 "applied_seq": 3, "uptime_s": 0.2}, 2.0)
+    assert [e["event"] for e in events] == ["restart-detected"]
+    assert m.replicas["r0"].acked_seq == 3
+    assert m.replicas["r0"].applied_seq == 3
+
+
+def test_membership_stale_probe_doc_is_not_a_restart():
+    """A probed /healthz rendered BEFORE recent fan-out legs landed
+    carries an applied_seq below the leg-updated mark. Same life (uptime
+    grew), so no restart event — and the mark never regresses."""
+    m = Membership(RouterPolicy())
+    m.add("r0")
+    m.note_probe("r0", {"ok": True, "ready": True, "applied_seq": 2,
+                        "uptime_s": 5.0}, 1.0)
+    r = m.replicas["r0"]
+    r.applied_seq = 6  # _note_leg advanced the mark between probes
+    r.acked_seq = 6
+    events = m.note_probe("r0", {"ok": True, "ready": True,
+                                 "applied_seq": 4, "uptime_s": 5.5}, 2.0)
+    assert events == []
+    assert r.applied_seq == 6 and r.acked_seq == 6
+
+
+def test_modelreplica_refuses_gapped_seq():
+    """The gapless-mark contract, driven directly: a seq past
+    applied+1 is a 409-shaped refusal that applies NOTHING, replays of
+    the hole land in order, and at-or-below seqs stay duplicates."""
+    rep = ModelReplica(dim=8, k=3)  # never started: pure state checks
+    try:
+        out = rep.apply_mutation("/upsert", "t", [1], 1)
+        assert out["applied_seq"] == 1
+        out = rep.apply_mutation("/upsert", "t", [2], 3)
+        assert out == {"error": "seq-gap", "status": 409,
+                       "applied_seq": 1}
+        snap = rep.snapshot()
+        assert snap["applied_seq"] == 1 and len(snap["mutations"]) == 1
+        assert rep.apply_mutation("/upsert", "t", [2], 2)[
+            "applied_seq"] == 2
+        assert rep.apply_mutation("/upsert", "t", [2], 2)["duplicate"]
+    finally:
+        rep._httpd.server_close()
+
+
+def test_transient_fanout_failure_never_gaps_a_replica():
+    """One replica's fan-out leg fails transiently while it stays in
+    rotation: later live legs must 409 against its gapless mark (never
+    apply over the hole and silently lose the missed mutation), and the
+    probe loop's replay closes the hole IN ORDER."""
+    policy = RouterPolicy(probe_interval_s=30.0, evict_after=3,
+                          rejoin_after=1)  # one startup probe cycle,
+    # then no replay until the test invokes it explicitly
+    body = json.dumps({"ids": [1]}).encode()
+    with _Fleet(2, policy=policy) as f:
+        lagger = f.replicas[1]
+        lagger.drop_mutations(True)
+        status, doc = f.router.mutate("/upsert", "t", body)
+        assert status == 200
+        assert doc["applied"] == ["r0"] and doc["failed"] == ["r1"]
+        lagger.drop_mutations(False)
+        # the leg for seq 2 reaches a healthy replica still missing
+        # seq 1: the gapless mark refuses it — lagging, never gapped
+        status, doc = f.router.mutate("/upsert", "t", body)
+        assert status == 200
+        assert doc["applied"] == ["r0"] and doc["failed"] == ["r1"]
+        snap = lagger.snapshot()
+        assert snap["applied_seq"] == 0 and snap["mutations"] == []
+        # the health surface reads the published posture, not _mutlock
+        assert f.router.stats()["seq"] == 2
+        # one probe cycle replays the hole forward, in order
+        f.router._probe_once()
+        snap = lagger.snapshot()
+        assert snap["applied_seq"] == 2
+        assert [m[0] for m in snap["mutations"]] == [1, 2]
+        assert f.replicas[0].snapshot()["applied_seq"] == 2
+
+
+def test_pool_pruning_and_stop_close_stranded_connections():
+    """A supervised restart publishes a new port: pooled keep-alive
+    sockets under the old url must be closed by the probe cycle's
+    prune, and Router.stop() must close whatever remains."""
+    class _Conn:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    router = Router({"r0": "http://127.0.0.1:9/"})  # never started
+    old, probe_old, cur = _Conn(), _Conn(), _Conn()
+    router._pools = {
+        ("r0", "http://old:1"): [old],
+        ("probe", "http://old:1"): [probe_old],
+        ("r0", "http://cur:1"): [cur],
+    }
+    router._prune_pools({"r0": "http://cur:1"})
+    assert old.closed and probe_old.closed and not cur.closed
+    assert list(router._pools) == [("r0", "http://cur:1")]
+    router.stop()  # never started: must not raise, must drain pools
+    assert cur.closed and router._pools == {}
